@@ -1,0 +1,21 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A ground-up rebuild of the capabilities of Tendermint Core v0.12.1
+(reference: /root/reference, Go) designed TPU-first:
+
+- **Hot numeric plane** (`tendermint_tpu.ops`, `tendermint_tpu.parallel`):
+  ed25519 batch signature verification, SHA-256/SHA-512/RIPEMD-160 hashing and
+  Merkle tree reduction as JAX/Pallas kernels — pure, fixed-shape, integer-only,
+  deterministic, sharded over `jax.sharding.Mesh` for multi-chip scale.
+- **Control plane** (host Python + C++): consensus state machine, WAL,
+  mempool, p2p gossip, RPC, storage — async IO around an event-sourced
+  functional core.
+
+The seam between the two planes is `crypto.BatchVerifier` / `merkle.TreeHasher`
+— the exact interface positions occupied by `crypto.PubKey.VerifyBytes` and
+`tmlibs/merkle.SimpleHash*` in the reference (see SURVEY.md §2b).
+"""
+
+from tendermint_tpu.version import __version__
+
+__all__ = ["__version__"]

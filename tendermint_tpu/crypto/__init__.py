@@ -1,0 +1,34 @@
+"""Crypto primitives and the batch-verification seam.
+
+Host-side keys/signing wrap the `cryptography` library (the role go-crypto's
+`PrivKeyEd25519/PubKeyEd25519` play in the reference, SURVEY.md §2b). The
+TPU-facing surface is `BatchVerifier` (`tendermint_tpu.crypto.batch`):
+accumulate (pubkey, message, signature) triples, flush as one batched
+ed25519 verification on device — replacing the reference's one-at-a-time
+`PubKey.VerifyBytes` calls at `types/vote_set.go:177` and
+`types/validator_set.go:253`.
+"""
+
+from tendermint_tpu.crypto.hashing import ADDRESS_LEN, address_hash, ripemd160, sha256, tmhash
+from tendermint_tpu.crypto.keys import (
+    PRIVKEY_SEED_LEN,
+    PUBKEY_LEN,
+    SIGNATURE_LEN,
+    PrivKey,
+    PubKey,
+    gen_priv_key,
+)
+
+__all__ = [
+    "PrivKey",
+    "PubKey",
+    "gen_priv_key",
+    "sha256",
+    "ripemd160",
+    "tmhash",
+    "address_hash",
+    "ADDRESS_LEN",
+    "PUBKEY_LEN",
+    "SIGNATURE_LEN",
+    "PRIVKEY_SEED_LEN",
+]

@@ -1,0 +1,49 @@
+"""Host hash functions.
+
+The reference hashes with RIPEMD-160 everywhere (Merkle nodes, part hashes,
+addresses — `docs/specification/merkle.rst:52-90`, `types/part_set.go:36-40`).
+This framework's native algorithm is SHA-256 (the TPU kernel target per
+BASELINE.md) with RIPEMD-160 retained as a compatibility variant; both have
+batched TPU implementations in `tendermint_tpu.ops`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+ADDRESS_LEN = 20
+
+# Default tree/leaf hash algorithm for the whole framework.
+DEFAULT_ALGO = "sha256"
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def sha512(data: bytes) -> bytes:
+    return hashlib.sha512(data).digest()
+
+
+def ripemd160(data: bytes) -> bytes:
+    h = hashlib.new("ripemd160")
+    h.update(data)
+    return h.digest()
+
+
+def tmhash(data: bytes, algo: str = DEFAULT_ALGO) -> bytes:
+    """The framework hash: SHA-256 (32B) by default, RIPEMD-160 (20B) compat."""
+    if algo == "sha256":
+        return sha256(data)
+    if algo == "ripemd160":
+        return ripemd160(data)
+    raise ValueError(f"unknown hash algo {algo!r}")
+
+
+def address_hash(pubkey_bytes: bytes) -> bytes:
+    """Validator/node address = first 20 bytes of SHA-256 of the raw pubkey.
+
+    (Reference derives addresses by RIPEMD-160 of the go-wire-encoded pubkey;
+    we define the analogous deterministic 20-byte address natively.)
+    """
+    return sha256(pubkey_bytes)[:ADDRESS_LEN]

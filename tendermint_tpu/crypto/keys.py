@@ -1,0 +1,91 @@
+"""ed25519 keys and host-side sign/verify.
+
+Fills the slot of go-crypto's `PrivKeyEd25519`/`PubKeyEd25519`/`Signature`
+(reference call sites: `types/priv_validator.go:92` signing,
+`types/vote_set.go:177` and `types/validator_set.go:253` verification).
+Host path wraps the `cryptography` library; the batched device path lives in
+`tendermint_tpu.ops.ed25519` and is cross-validated against this one.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import serialization
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from tendermint_tpu.crypto.hashing import address_hash
+
+PRIVKEY_SEED_LEN = 32
+PUBKEY_LEN = 32
+SIGNATURE_LEN = 64
+
+
+@dataclass(frozen=True)
+class PubKey:
+    """32-byte ed25519 public key."""
+
+    data: bytes
+
+    def __post_init__(self) -> None:
+        if len(self.data) != PUBKEY_LEN:
+            raise ValueError(f"pubkey must be {PUBKEY_LEN} bytes, got {len(self.data)}")
+
+    def verify(self, msg: bytes, signature: bytes) -> bool:
+        """One-at-a-time host verification (the slow reference path)."""
+        if len(signature) != SIGNATURE_LEN:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(self.data).verify(signature, msg)
+            return True
+        except InvalidSignature:
+            return False
+        except Exception:
+            return False
+
+    @property
+    def address(self) -> bytes:
+        return address_hash(self.data)
+
+    def __bytes__(self) -> bytes:
+        return self.data
+
+    def hex(self) -> str:
+        return self.data.hex()
+
+
+@dataclass(frozen=True)
+class PrivKey:
+    """ed25519 private key from a 32-byte seed (RFC 8032 style)."""
+
+    seed: bytes
+
+    def __post_init__(self) -> None:
+        if len(self.seed) != PRIVKEY_SEED_LEN:
+            raise ValueError(f"privkey seed must be {PRIVKEY_SEED_LEN} bytes")
+
+    def _key(self) -> Ed25519PrivateKey:
+        return Ed25519PrivateKey.from_private_bytes(self.seed)
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._key().sign(msg)
+
+    @property
+    def pub_key(self) -> PubKey:
+        raw = self._key().public_key().public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw
+        )
+        return PubKey(raw)
+
+    def __repr__(self) -> str:  # never leak the seed
+        return f"PrivKey(pub={self.pub_key.hex()[:16]}…)"
+
+
+def gen_priv_key(seed: bytes | None = None) -> PrivKey:
+    """Generate a key; pass a fixed seed for deterministic test fixtures."""
+    return PrivKey(seed if seed is not None else os.urandom(PRIVKEY_SEED_LEN))

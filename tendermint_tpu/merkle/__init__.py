@@ -1,0 +1,26 @@
+"""Merkle trees (host reference implementation + the TreeHasher seam).
+
+Role of `tmlibs/merkle` in the reference (`SimpleHashFromHashes`,
+`SimpleHashFromBinaries`, proofs — spec at `docs/specification/merkle.rst:52-90`;
+call sites `types/block.go:177`, `types/validator_set.go:153`, `types/tx.go:33-46`,
+`types/part_set.go:111,204`). The batched device tree hasher lives in
+`tendermint_tpu.ops.merkle_kernel` behind the `TreeHasher` interface.
+"""
+
+from tendermint_tpu.merkle.simple import (
+    SimpleProof,
+    simple_hash_from_byte_slices,
+    simple_hash_from_hashes,
+    simple_hash_from_map,
+    simple_proofs_from_byte_slices,
+    verify_proof,
+)
+
+__all__ = [
+    "simple_hash_from_hashes",
+    "simple_hash_from_byte_slices",
+    "simple_hash_from_map",
+    "simple_proofs_from_byte_slices",
+    "SimpleProof",
+    "verify_proof",
+]

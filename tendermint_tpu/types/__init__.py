@@ -1,0 +1,87 @@
+"""Domain types (reference layer 1, `types/` — SURVEY.md §1)."""
+
+from tendermint_tpu.types.block import Block, Commit, Data, Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.errors import (
+    ErrDoubleSign,
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
+    ErrVoteUnexpectedStep,
+    TMError,
+    ValidationError,
+    VoteError,
+)
+from tendermint_tpu.types.events import EventCache, EventSwitch
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.heartbeat import Heartbeat
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.part_set import DEFAULT_PART_SIZE, Part, PartSet, PartSetHeader
+from tendermint_tpu.types.priv_validator import (
+    STEP_NONE,
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSE,
+    PrivValidator,
+    PrivValidatorFS,
+    Signer,
+)
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.tx import Tx, TxProof, Txs, tx_hash
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    Vote,
+    is_vote_type_valid,
+)
+from tendermint_tpu.types.vote_set import VoteSet
+
+__all__ = [
+    "Block",
+    "BlockID",
+    "Commit",
+    "ConsensusParams",
+    "Data",
+    "DEFAULT_PART_SIZE",
+    "ErrDoubleSign",
+    "ErrVoteConflictingVotes",
+    "ErrVoteInvalidSignature",
+    "ErrVoteInvalidValidatorAddress",
+    "ErrVoteInvalidValidatorIndex",
+    "ErrVoteNonDeterministicSignature",
+    "ErrVoteUnexpectedStep",
+    "EventCache",
+    "EventSwitch",
+    "GenesisDoc",
+    "GenesisValidator",
+    "Header",
+    "Heartbeat",
+    "Part",
+    "PartSet",
+    "PartSetHeader",
+    "PrivValidator",
+    "PrivValidatorFS",
+    "Proposal",
+    "Signer",
+    "STEP_NONE",
+    "STEP_PRECOMMIT",
+    "STEP_PREVOTE",
+    "STEP_PROPOSE",
+    "TMError",
+    "Tx",
+    "TxProof",
+    "Txs",
+    "tx_hash",
+    "ValidationError",
+    "Validator",
+    "ValidatorSet",
+    "Vote",
+    "VoteError",
+    "VoteSet",
+    "VOTE_TYPE_PRECOMMIT",
+    "VOTE_TYPE_PREVOTE",
+    "is_vote_type_valid",
+]

@@ -1,0 +1,53 @@
+"""Typed errors for the domain layer (reference: types/vote_set.go errors,
+types/priv_validator.go double-sign refusal)."""
+
+from __future__ import annotations
+
+
+class TMError(Exception):
+    """Base class for framework domain errors."""
+
+
+class ValidationError(TMError):
+    """A structure failed ValidateBasic-style checks."""
+
+
+class VoteError(TMError):
+    pass
+
+
+class ErrVoteUnexpectedStep(VoteError):
+    pass
+
+
+class ErrVoteInvalidValidatorIndex(VoteError):
+    pass
+
+
+class ErrVoteInvalidValidatorAddress(VoteError):
+    pass
+
+
+class ErrVoteInvalidSignature(VoteError):
+    pass
+
+
+class ErrVoteNonDeterministicSignature(VoteError):
+    pass
+
+
+class ErrVoteConflictingVotes(VoteError):
+    """Duplicate-vote evidence: one validator, two different votes for the
+    same (height, round, type) — reference `types/vote_set.go:182-195`."""
+
+    def __init__(self, vote_a, vote_b):
+        super().__init__(
+            f"conflicting votes from validator {vote_a.validator_address.hex()}"
+        )
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+
+
+class ErrDoubleSign(TMError):
+    """PrivValidator refused to sign: height/round/step regression or
+    conflicting sign-bytes (reference `types/priv_validator.go:225-275`)."""

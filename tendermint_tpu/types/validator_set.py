@@ -1,0 +1,273 @@
+"""Validators and the weighted-round-robin proposer rotation.
+
+Reference: `types/validator_set.go` — sorted-by-address set, `IncrementAccum`
+proposer selection (`:52-69`), Merkle hash of the set (`:145`), and the HOT
+LOOP `VerifyCommit` (`:225-269`) which the reference runs as N sequential
+ed25519 verifications. Here `verify_commit` routes through a `BatchVerifier`
+(one device batch per commit) with the host loop as fallback.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from tendermint_tpu.codec import Writer
+from tendermint_tpu.crypto import PubKey
+from tendermint_tpu.merkle import simple_hash_from_byte_slices
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+
+
+@dataclass(frozen=True)
+class Validator:
+    address: bytes
+    pub_key: PubKey
+    voting_power: int
+    accum: int = 0
+
+    def encode(self) -> bytes:
+        """Deterministic encoding hashed into the validator-set root."""
+        return (
+            Writer().bytes(self.address).bytes(self.pub_key.data).uvarint(self.voting_power).build()
+        )
+
+    def compare_proposer_priority(self, other: "Validator") -> "Validator":
+        """Higher accum wins; ties break to the lower address
+        (reference `Validator.CompareAccum`)."""
+        if self.accum > other.accum:
+            return self
+        if self.accum < other.accum:
+            return other
+        return self if self.address < other.address else other
+
+
+class ValidatorSet:
+    def __init__(self, validators: list[Validator]):
+        seen: set[bytes] = set()
+        for v in validators:
+            if v.address in seen:
+                raise ValidationError(f"duplicate validator address {v.address.hex()}")
+            if v.voting_power < 0:
+                raise ValidationError("negative voting power")
+            seen.add(v.address)
+        self.validators: list[Validator] = sorted(validators, key=lambda v: v.address)
+        self._total = sum(v.voting_power for v in self.validators)
+        self._proposer: Validator | None = None
+
+    # -- basic accessors ---------------------------------------------------
+
+    def size(self) -> int:
+        return len(self.validators)
+
+    def __len__(self) -> int:
+        return len(self.validators)
+
+    @property
+    def total_voting_power(self) -> int:
+        return self._total
+
+    def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
+        # validators are sorted by address — binary search (reference uses
+        # sort.Search; a linear scan would make commit verification O(n^2)).
+        import bisect
+
+        addrs = [v.address for v in self.validators]
+        i = bisect.bisect_left(addrs, address)
+        if i < len(addrs) and addrs[i] == address:
+            return i, self.validators[i]
+        return -1, None
+
+    def get_by_index(self, index: int) -> Validator | None:
+        if 0 <= index < len(self.validators):
+            return self.validators[index]
+        return None
+
+    def has_address(self, address: bytes) -> bool:
+        return self.get_by_address(address)[0] >= 0
+
+    def copy(self) -> "ValidatorSet":
+        vs = ValidatorSet(list(self.validators))
+        vs._proposer = self._proposer
+        return vs
+
+    # -- proposer rotation -------------------------------------------------
+
+    def increment_accum(self, times: int = 1) -> None:
+        """Weighted round-robin (reference `IncrementAccum
+        types/validator_set.go:52-69`): each step adds voting power to every
+        accumulator, picks the max as proposer, subtracts total power from it."""
+        for _ in range(times):
+            self.validators = [
+                replace(v, accum=v.accum + v.voting_power) for v in self.validators
+            ]
+            proposer = self.validators[0]
+            for v in self.validators[1:]:
+                proposer = proposer.compare_proposer_priority(v)
+            idx, _ = self.get_by_address(proposer.address)
+            self.validators[idx] = replace(proposer, accum=proposer.accum - self._total)
+            self._proposer = self.validators[idx]
+
+    @property
+    def proposer(self) -> Validator:
+        if not self.validators:
+            raise ValidationError("empty validator set has no proposer")
+        if self._proposer is None:
+            p = self.validators[0]
+            for v in self.validators[1:]:
+                p = p.compare_proposer_priority(v)
+            self._proposer = p
+        return self._proposer
+
+    # -- hashing -----------------------------------------------------------
+
+    def hash(self) -> bytes:
+        """Merkle root of the validator encodings (reference `Hash :145`)."""
+        return simple_hash_from_byte_slices([v.encode() for v in self.validators])
+
+    # -- membership changes (EndBlock diffs) --------------------------------
+
+    def apply_changes(self, changes: list[Validator]) -> None:
+        """Apply app-driven diffs: power 0 removes, new address adds, else
+        updates (reference `updateValidators state/execution.go:120-159`)."""
+        for c in changes:
+            idx, existing = self.get_by_address(c.address)
+            if c.voting_power == 0:
+                if existing is None:
+                    raise ValidationError("removing unknown validator")
+                self.validators.pop(idx)
+            elif existing is None:
+                self.validators.append(replace(c, accum=0))
+            else:
+                self.validators[idx] = replace(existing, voting_power=c.voting_power)
+        self.validators.sort(key=lambda v: v.address)
+        self._total = sum(v.voting_power for v in self.validators)
+        self._proposer = None
+
+    # -- commit verification (the hot loop) ---------------------------------
+
+    def _collect_commit_sigs(
+        self, chain_id: str, block_id: BlockID, height: int, commit
+    ) -> tuple[list[tuple[bytes, bytes, bytes]], list[int]]:
+        """Shared validation walk: returns (pubkey,msg,sig) triples and the
+        vote indices they came from."""
+        if len(self.validators) != len(commit.precommits):
+            raise ValidationError(
+                f"commit size {len(commit.precommits)} != valset size {len(self.validators)}"
+            )
+        if height != commit.height():
+            raise ValidationError(f"commit height {commit.height()} != {height}")
+        round_ = commit.round()
+        triples: list[tuple[bytes, bytes, bytes]] = []
+        indices: list[int] = []
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            if precommit.height != height:
+                raise ValidationError(f"precommit height {precommit.height} != {height}")
+            if precommit.round != round_:
+                raise ValidationError(f"precommit round {precommit.round} != {round_}")
+            if precommit.type != VOTE_TYPE_PRECOMMIT:
+                raise ValidationError("commit vote is not a precommit")
+            val = self.validators[idx]
+            triples.append(
+                (val.pub_key.data, precommit.sign_bytes(chain_id), precommit.signature)
+            )
+            indices.append(idx)
+        return triples, indices
+
+    def verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+    ) -> None:
+        """Raise unless >2/3 of this set's power signed block_id at height.
+
+        Reference `VerifyCommit types/validator_set.go:225-269` — but instead
+        of one ed25519 verify per iteration, all signatures flush as a single
+        device batch when a `BatchVerifier` is supplied.
+        """
+        triples, indices = self._collect_commit_sigs(chain_id, block_id, height, commit)
+        ok_mask = _verify_triples(triples, verifier)
+        tallied = 0
+        for ok, idx in zip(ok_mask, indices):
+            precommit = commit.precommits[idx]
+            if not ok:
+                raise ValidationError(f"invalid commit signature from validator {idx}")
+            if precommit.block_id == block_id:
+                tallied += self.validators[idx].voting_power
+        if not tallied * 3 > self._total * 2:
+            raise ValidationError(
+                f"insufficient voting power: {tallied} of {self._total}"
+            )
+
+    def verify_commit_any(
+        self, new_set: "ValidatorSet", chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+    ) -> None:
+        """Light-client rule (reference `VerifyCommitAny
+        types/validator_set.go:284-349`): enough of the OLD set (this one,
+        >2/3) must have signed the commit produced under `new_set`, matching
+        validators by address across the two sets."""
+        if len(new_set.validators) != len(commit.precommits):
+            raise ValidationError("commit size != new valset size")
+        if height != commit.height():
+            raise ValidationError("commit height mismatch")
+        round_ = commit.round()
+        triples: list[tuple[bytes, bytes, bytes]] = []
+        old_powers: list[int] = []
+        new_powers: list[int] = []
+        seen: set[bytes] = set()
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            # Every non-nil precommit must be well-formed, even ones for other
+            # blocks (matches verify_commit; reference validates all votes).
+            if precommit.height != height or precommit.round != round_:
+                raise ValidationError("commit vote height/round mismatch")
+            if precommit.type != VOTE_TYPE_PRECOMMIT:
+                raise ValidationError("commit vote is not a precommit")
+            if precommit.block_id != block_id:
+                continue
+            new_val = new_set.validators[idx]
+            _, old_val = self.get_by_address(new_val.address)
+            if old_val is None or old_val.address in seen:
+                continue
+            seen.add(old_val.address)
+            triples.append(
+                (old_val.pub_key.data, precommit.sign_bytes(chain_id), precommit.signature)
+            )
+            old_powers.append(old_val.voting_power)
+            new_powers.append(new_val.voting_power)
+        ok_mask = _verify_triples(triples, verifier)
+        old_tallied = 0
+        new_tallied = 0
+        for ok, op, np_ in zip(ok_mask, old_powers, new_powers):
+            if not ok:
+                raise ValidationError("invalid commit signature (old set)")
+            old_tallied += op
+            new_tallied += np_
+        # BOTH quorums must hold: >2/3 of the old (trusted) set AND >2/3 of the
+        # new set — otherwise a grown set could be "committed" by a minority of
+        # its power (reference validator_set.go:340-346).
+        if not old_tallied * 3 > self._total * 2:
+            raise ValidationError(
+                f"insufficient old voting power: {old_tallied} of {self._total}"
+            )
+        if not new_tallied * 3 > new_set.total_voting_power * 2:
+            raise ValidationError(
+                f"insufficient new voting power: {new_tallied} of {new_set.total_voting_power}"
+            )
+
+    def __iter__(self):
+        return iter(self.validators)
+
+    def __repr__(self) -> str:
+        return f"ValidatorSet(n={len(self.validators)}, power={self._total})"
+
+
+def _verify_triples(triples: list[tuple[bytes, bytes, bytes]], verifier) -> list[bool]:
+    """Verify (pubkey,msg,sig) triples: one device batch if a BatchVerifier is
+    given, else the host loop (the reference's sequential path)."""
+    if not triples:
+        return []
+    if verifier is not None:
+        return list(verifier.verify_batch(triples))
+    return [PubKey(pk).verify(msg, sig) for pk, msg, sig in triples]

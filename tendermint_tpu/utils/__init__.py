@@ -1,0 +1,1 @@
+"""Host runtime utilities (the tmlibs role: SURVEY.md §2b layer 0)."""

@@ -1,0 +1,11 @@
+"""Test environment: force JAX onto a virtual 8-device CPU mesh so multi-chip
+sharding paths compile and run without TPU hardware (the driver separately
+dry-runs multi-chip via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,114 @@
+"""Shared test fixtures: deterministic validators, votes, commits.
+
+Mirrors the role of the reference's `consensus/common_test.go` +
+`types/vote_set_test.go` fixture helpers.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tendermint_tpu.crypto import PrivKey
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    BlockID,
+    Commit,
+    PartSetHeader,
+    PrivValidator,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+
+CHAIN_ID = "test-chain"
+
+
+def det_priv_keys(n: int) -> list[PrivKey]:
+    return [PrivKey(i.to_bytes(32, "little")) for i in range(1, n + 1)]
+
+
+def make_validators(n: int, power: int = 10) -> tuple[ValidatorSet, list[PrivValidator]]:
+    """N deterministic validators with equal power; privs index-aligned with
+    the sorted validator set."""
+    privs = [PrivValidator(k) for k in det_priv_keys(n)]
+    vals = [
+        Validator(address=p.address, pub_key=p.pub_key, voting_power=power) for p in privs
+    ]
+    vs = ValidatorSet(vals)
+    privs_by_addr = {p.address: p for p in privs}
+    ordered = [privs_by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def make_block_id(seed: bytes = b"blk") -> BlockID:
+    import hashlib
+
+    h = hashlib.sha256(seed).digest()
+    return BlockID(hash=h, parts_header=PartSetHeader(total=1, hash=h[:20]))
+
+
+def signed_vote(
+    priv: PrivValidator,
+    index: int,
+    height: int,
+    round_: int,
+    type_: int,
+    block_id: BlockID,
+    chain_id: str = CHAIN_ID,
+    timestamp: int | None = None,
+) -> Vote:
+    vote = Vote(
+        validator_address=priv.address,
+        validator_index=index,
+        height=height,
+        round=round_,
+        timestamp=timestamp if timestamp is not None else time.time_ns(),
+        type=type_,
+        block_id=block_id,
+    )
+    return priv.sign_vote(chain_id, vote)
+
+
+def byzantine_signed_vote(
+    priv: PrivValidator,
+    index: int,
+    height: int,
+    round_: int,
+    type_: int,
+    block_id: BlockID,
+    chain_id: str = CHAIN_ID,
+    timestamp: int = 1000,
+) -> Vote:
+    """Sign bypassing the double-sign guard (Byzantine test behavior —
+    the reference's ByzantinePrivValidator role)."""
+    vote = Vote(
+        validator_address=priv.address,
+        validator_index=index,
+        height=height,
+        round=round_,
+        timestamp=timestamp,
+        type=type_,
+        block_id=block_id,
+    )
+    sig = priv._signer.sign(vote.sign_bytes(chain_id))
+    return vote.with_signature(sig)
+
+
+def make_commit(
+    val_set: ValidatorSet,
+    privs: list[PrivValidator],
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    chain_id: str = CHAIN_ID,
+    n_sign: int | None = None,
+) -> Commit:
+    """Build a commit by running the real VoteSet quorum machinery."""
+    vote_set = VoteSet(chain_id, height, round_, VOTE_TYPE_PRECOMMIT, val_set)
+    n = n_sign if n_sign is not None else len(privs)
+    for i in range(n):
+        vote_set.add_vote(
+            signed_vote(privs[i], i, height, round_, VOTE_TYPE_PRECOMMIT, block_id, chain_id)
+        )
+    return vote_set.make_commit()

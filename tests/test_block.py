@@ -1,0 +1,83 @@
+import time
+
+import pytest
+
+from tendermint_tpu.types import Block, BlockID, Commit, Data, Txs, ValidationError
+from tests.helpers import CHAIN_ID, make_block_id, make_commit, make_validators
+
+
+def make_test_block(height=2, n_txs=5):
+    vs, privs = make_validators(4)
+    last_bid = make_block_id(b"prev")
+    last_commit = make_commit(vs, privs, height=height - 1, round_=0, block_id=last_bid)
+    txs = Txs(f"tx-{i}".encode() for i in range(n_txs))
+    return Block.make_block(
+        height=height,
+        chain_id=CHAIN_ID,
+        txs=txs,
+        last_commit=last_commit,
+        last_block_id=last_bid,
+        time=time.time_ns(),
+        validators_hash=vs.hash(),
+        app_hash=b"\x01" * 32,
+    )
+
+
+def test_block_hash_stable_and_nonempty():
+    b = make_test_block()
+    h1, h2 = b.hash(), b.hash()
+    assert h1 == h2 and len(h1) == 32
+
+
+def test_header_hash_changes_with_fields():
+    b1, b2 = make_test_block(), make_test_block()
+    b2.header.app_hash = b"\x02" * 32
+    assert b1.hash() != b2.hash()
+
+
+def test_validate_basic_ok():
+    make_test_block().validate_basic()
+
+
+def test_validate_basic_catches_num_txs():
+    b = make_test_block()
+    b.header.num_txs = 99
+    with pytest.raises(ValidationError):
+        b.validate_basic()
+
+
+def test_validate_basic_catches_data_tamper():
+    b = make_test_block()
+    b.data.txs[0] = b"evil"
+    with pytest.raises(ValidationError):
+        b.validate_basic()
+
+
+def test_encode_decode_roundtrip():
+    b = make_test_block()
+    b2 = Block.decode(b.encode())
+    assert b2.hash() == b.hash()
+    assert b2.data.txs == b.data.txs
+    assert b2.last_commit.block_id == b.last_commit.block_id
+    b2.validate_basic()
+
+
+def test_part_set_roundtrip():
+    b = make_test_block(n_txs=200)
+    ps = b.make_part_set(part_size=512)
+    assert ps.total > 1
+    assert Block.decode(ps.assemble()).hash() == b.hash()
+
+
+def test_commit_validate_basic():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    c = make_commit(vs, privs, height=3, round_=1, block_id=bid)
+    c.validate_basic()
+    assert c.height() == 3 and c.round() == 1
+    assert c.bit_array().num_set() == 4
+
+
+def test_empty_commit_for_height_1():
+    b = make_test_block(height=2)
+    assert Commit.empty().size() == 0

@@ -1,0 +1,75 @@
+import pytest
+
+from tendermint_tpu.codec import (
+    Reader,
+    Writer,
+    canonical_dumps,
+    decode_svarint,
+    decode_uvarint,
+    encode_svarint,
+    encode_uvarint,
+)
+
+
+@pytest.mark.parametrize("n", [0, 1, 127, 128, 300, 2**32, 2**63 - 1, 2**64])
+def test_uvarint_roundtrip(n):
+    enc = encode_uvarint(n)
+    dec, off = decode_uvarint(enc)
+    assert dec == n and off == len(enc)
+
+
+@pytest.mark.parametrize("n", [0, 1, -1, 63, -64, 2**40, -(2**40), 2**62, -(2**62)])
+def test_svarint_roundtrip(n):
+    dec, off = decode_svarint(encode_svarint(n))
+    assert dec == n
+
+
+def test_uvarint_negative_raises():
+    with pytest.raises(ValueError):
+        encode_uvarint(-1)
+
+
+def test_truncated_uvarint():
+    with pytest.raises(ValueError):
+        decode_uvarint(b"\x80")
+
+
+def test_writer_reader_roundtrip():
+    w = (
+        Writer()
+        .uvarint(42)
+        .svarint(-7)
+        .bytes(b"hello")
+        .string("wörld")
+        .bool(True)
+        .bool(False)
+        .raw(b"\xff\x00")
+    )
+    r = Reader(w.build())
+    assert r.uvarint() == 42
+    assert r.svarint() == -7
+    assert r.bytes() == b"hello"
+    assert r.string() == "wörld"
+    assert r.bool() is True
+    assert r.bool() is False
+    assert r.raw(2) == b"\xff\x00"
+    r.expect_done()
+
+
+def test_reader_trailing_bytes_detected():
+    r = Reader(b"\x00\x01")
+    r.uvarint()
+    with pytest.raises(ValueError):
+        r.expect_done()
+
+
+def test_canonical_json_deterministic_and_sorted():
+    a = canonical_dumps({"b": 1, "a": b"\xde\xad", "c": {"z": 2, "y": [1, 2]}})
+    b = canonical_dumps({"c": {"y": [1, 2], "z": 2}, "a": b"\xde\xad", "b": 1})
+    assert a == b
+    assert a == b'{"a":"DEAD","b":1,"c":{"y":[1,2],"z":2}}'
+
+
+def test_canonical_json_rejects_floats():
+    with pytest.raises(TypeError):
+        canonical_dumps({"x": 1.5})

@@ -1,0 +1,53 @@
+from tendermint_tpu.crypto import PrivKey, PubKey, gen_priv_key
+from tendermint_tpu.crypto.hashing import address_hash, ripemd160, sha256
+
+
+def test_sign_verify_roundtrip():
+    k = gen_priv_key(b"\x07" * 32)
+    msg = b"consensus is fun"
+    sig = k.sign(msg)
+    assert len(sig) == 64
+    assert k.pub_key.verify(msg, sig)
+    assert not k.pub_key.verify(msg + b"!", sig)
+    assert not k.pub_key.verify(msg, bytes(64))
+
+
+def test_deterministic_keys():
+    a = PrivKey(b"\x01" * 32)
+    b = PrivKey(b"\x01" * 32)
+    assert a.pub_key == b.pub_key
+    assert a.sign(b"m") == b.sign(b"m")  # ed25519 is deterministic
+
+
+def test_address():
+    k = gen_priv_key(b"\x02" * 32)
+    addr = k.pub_key.address
+    assert len(addr) == 20
+    assert addr == address_hash(k.pub_key.data)
+
+
+def test_rfc8032_vector_1():
+    # RFC 8032 §7.1 TEST 1: empty message
+    seed = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    k = PrivKey(seed)
+    assert k.pub_key.data == bytes.fromhex(
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig = k.sign(b"")
+    assert sig == bytes.fromhex(
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert k.pub_key.verify(b"", sig)
+
+
+def test_hashes():
+    assert sha256(b"abc").hex().startswith("ba7816bf")
+    assert ripemd160(b"abc").hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+
+
+def test_repr_does_not_leak_seed():
+    k = PrivKey(b"\x03" * 32)
+    assert "030303" not in repr(k)

@@ -1,0 +1,49 @@
+import pytest
+
+from tendermint_tpu.types import GenesisDoc, GenesisValidator, ValidationError
+from tests.helpers import det_priv_keys
+
+
+def make_genesis(n=4):
+    keys = det_priv_keys(n)
+    return GenesisDoc(
+        chain_id="test-chain",
+        validators=[GenesisValidator(pub_key=k.pub_key, power=10) for k in keys],
+    )
+
+
+def test_roundtrip_json():
+    doc = make_genesis()
+    doc.validate_and_complete()
+    doc2 = GenesisDoc.from_json(doc.to_json())
+    assert doc2.chain_id == doc.chain_id
+    assert doc2.validator_hash() == doc.validator_hash()
+    assert doc2.genesis_time == doc.genesis_time
+
+
+def test_save_load_file(tmp_path):
+    doc = make_genesis()
+    doc.validate_and_complete()
+    p = str(tmp_path / "genesis.json")
+    doc.save_as(p)
+    assert GenesisDoc.from_file(p).validator_hash() == doc.validator_hash()
+
+
+def test_empty_chain_id_rejected():
+    doc = make_genesis()
+    doc.chain_id = ""
+    with pytest.raises(ValidationError):
+        doc.validate_and_complete()
+
+
+def test_no_validators_rejected():
+    doc = make_genesis()
+    doc.validators = []
+    with pytest.raises(ValidationError):
+        doc.validate_and_complete()
+
+
+def test_validator_set_size():
+    doc = make_genesis(7)
+    doc.validate_and_complete()
+    assert doc.validator_set().size() == 7

@@ -1,0 +1,72 @@
+import hashlib
+
+import pytest
+
+from tendermint_tpu.merkle import (
+    simple_hash_from_byte_slices,
+    simple_hash_from_hashes,
+    simple_proofs_from_byte_slices,
+    verify_proof,
+)
+from tendermint_tpu.merkle.simple import (
+    inner_hash,
+    leaf_hash,
+    simple_hash_from_map,
+)
+
+
+def test_empty_and_single():
+    assert simple_hash_from_byte_slices([]) == b""
+    one = simple_hash_from_byte_slices([b"x"])
+    assert one == hashlib.sha256(b"\x00x").digest()
+
+
+def test_two_leaves_structure():
+    l0, l1 = leaf_hash(b"a"), leaf_hash(b"b")
+    assert simple_hash_from_byte_slices([b"a", b"b"]) == inner_hash(l0, l1)
+
+
+def test_split_rule_matches_reference_shape():
+    # 5 leaves: split at 4 (largest power of two < 5)
+    items = [bytes([i]) for i in range(5)]
+    lh = [leaf_hash(x) for x in items]
+    left = simple_hash_from_hashes(lh[:4])
+    right = lh[4]
+    assert simple_hash_from_byte_slices(items) == inner_hash(left, right)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13, 64, 100])
+def test_proofs_verify(n):
+    items = [f"item-{i}".encode() for i in range(n)]
+    root, proofs = simple_proofs_from_byte_slices(items)
+    assert root == simple_hash_from_byte_slices(items)
+    for i, item in enumerate(items):
+        assert verify_proof(root, item, proofs[i])
+
+
+def test_tampered_proof_fails():
+    items = [f"item-{i}".encode() for i in range(7)]
+    root, proofs = simple_proofs_from_byte_slices(items)
+    assert not verify_proof(root, b"other", proofs[3])
+    # wrong index's proof for the right item
+    assert not verify_proof(root, items[3], proofs[4])
+    # truncated aunts
+    p = proofs[3]
+    p.aunts = p.aunts[:-1]
+    assert not verify_proof(root, items[3], p)
+
+
+def test_leaf_inner_domain_separation():
+    # a leaf can't be reinterpreted as an inner node
+    assert leaf_hash(b"ab") != inner_hash(b"a", b"b")
+
+
+def test_hash_from_map_key_order_independent():
+    a = simple_hash_from_map({"x": b"1", "y": b"2"})
+    b = simple_hash_from_map({"y": b"2", "x": b"1"})
+    assert a == b and len(a) == 32
+
+
+def test_ripemd160_variant():
+    r = simple_hash_from_byte_slices([b"a", b"b"], algo="ripemd160")
+    assert len(r) == 20

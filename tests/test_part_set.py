@@ -1,0 +1,64 @@
+import os
+
+import pytest
+
+from tendermint_tpu.types import PartSet, ValidationError
+from tendermint_tpu.types.part_set import Part
+
+
+def test_roundtrip():
+    data = os.urandom(4096 * 3 + 100)
+    ps = PartSet.from_data(data, part_size=4096)
+    assert ps.total == 4
+    assert ps.is_complete()
+    assert ps.assemble() == data
+
+
+def test_gossip_reassembly():
+    data = os.urandom(10000)
+    src = PartSet.from_data(data, part_size=1024)
+    dst = PartSet.from_header(src.header)
+    assert not dst.is_complete()
+    # deliver out of order
+    order = list(range(src.total))[::-1]
+    for i in order:
+        assert dst.add_part(src.get_part(i))
+    assert dst.is_complete()
+    assert dst.assemble() == data
+
+
+def test_duplicate_part_ignored():
+    src = PartSet.from_data(b"x" * 5000, part_size=1024)
+    dst = PartSet.from_header(src.header)
+    assert dst.add_part(src.get_part(0))
+    assert not dst.add_part(src.get_part(0))
+
+
+def test_bad_proof_rejected():
+    src = PartSet.from_data(b"y" * 5000, part_size=1024)
+    dst = PartSet.from_header(src.header)
+    p = src.get_part(1)
+    tampered = Part(index=1, bytes_=p.bytes_ + b"!", proof=p.proof)
+    with pytest.raises(ValidationError):
+        dst.add_part(tampered)
+
+
+def test_wrong_index_rejected():
+    src = PartSet.from_data(b"z" * 5000, part_size=1024)
+    dst = PartSet.from_header(src.header)
+    p = src.get_part(1)
+    moved = Part(index=2, bytes_=p.bytes_, proof=p.proof)
+    with pytest.raises(ValidationError):
+        dst.add_part(moved)
+
+
+def test_part_encode_roundtrip():
+    src = PartSet.from_data(b"w" * 3000, part_size=1024)
+    p = src.get_part(2)
+    assert Part.decode(p.encode()).bytes_ == p.bytes_
+
+
+def test_empty_data_single_part():
+    ps = PartSet.from_data(b"", part_size=1024)
+    assert ps.total == 1
+    assert ps.assemble() == b""

@@ -1,0 +1,84 @@
+import pytest
+
+from tendermint_tpu.crypto import PrivKey
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    ErrDoubleSign,
+    PrivValidator,
+    PrivValidatorFS,
+    Vote,
+)
+from tests.helpers import CHAIN_ID, make_block_id
+
+
+def mk_vote(pv, height, round_, type_, bid, ts=1000):
+    return Vote(
+        validator_address=pv.address,
+        validator_index=0,
+        height=height,
+        round=round_,
+        timestamp=ts,
+        type=type_,
+        block_id=bid,
+    )
+
+
+def test_sign_vote_and_verify():
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    v = pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+    assert pv.pub_key.verify(v.sign_bytes(CHAIN_ID), v.signature)
+
+
+def test_double_sign_same_hrs_different_block_refused():
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id(b"a")))
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id(b"b")))
+
+
+def test_resign_identical_returns_cached():
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    v1 = pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+    v2 = pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+    assert v1.signature == v2.signature
+
+
+def test_regression_refused():
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PRECOMMIT, make_block_id()))
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+    # prevote after precommit at same height/round is also a step regression
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_vote(CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+
+
+def test_step_progression_allowed():
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    bid = make_block_id()
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PREVOTE, bid))
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 0, VOTE_TYPE_PRECOMMIT, bid))
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 1, 1, VOTE_TYPE_PREVOTE, bid))
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PREVOTE, bid))
+
+
+def test_fs_persistence_survives_reload(tmp_path):
+    path = str(tmp_path / "priv_validator.json")
+    pv = PrivValidatorFS.load_or_gen(path, seed=b"\x09" * 32)
+    pv.sign_vote(CHAIN_ID, mk_vote(pv, 3, 0, VOTE_TYPE_PRECOMMIT, make_block_id()))
+
+    pv2 = PrivValidatorFS.load(path)
+    assert pv2.address == pv.address
+    # double sign attempt after restart is still refused
+    with pytest.raises(ErrDoubleSign):
+        pv2.sign_vote(CHAIN_ID, mk_vote(pv2, 3, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+    # progress is fine
+    pv2.sign_vote(CHAIN_ID, mk_vote(pv2, 4, 0, VOTE_TYPE_PREVOTE, make_block_id()))
+
+
+def test_load_or_gen_idempotent(tmp_path):
+    path = str(tmp_path / "pv.json")
+    a = PrivValidatorFS.load_or_gen(path)
+    b = PrivValidatorFS.load_or_gen(path)
+    assert a.address == b.address

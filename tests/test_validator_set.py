@@ -1,0 +1,146 @@
+import pytest
+
+from tendermint_tpu.types import ValidationError, Validator, ValidatorSet
+from tests.helpers import CHAIN_ID, make_block_id, make_commit, make_validators
+
+
+def test_sorted_by_address():
+    vs, _ = make_validators(10)
+    addrs = [v.address for v in vs.validators]
+    assert addrs == sorted(addrs)
+    assert vs.total_voting_power == 100
+
+
+def test_proposer_rotation_equal_power_cycles():
+    vs, _ = make_validators(4)
+    seen = []
+    for _ in range(8):
+        vs.increment_accum(1)
+        seen.append(vs.proposer.address)
+    # equal power: each validator proposes twice over 8 rounds
+    from collections import Counter
+
+    counts = Counter(seen)
+    assert all(c == 2 for c in counts.values())
+
+
+def test_proposer_rotation_weighted():
+    _, privs = make_validators(3)
+    vals = [
+        Validator(address=p.address, pub_key=p.pub_key, voting_power=w)
+        for p, w in zip(privs, [1, 1, 8])
+    ]
+    vs = ValidatorSet(vals)
+    heavy = vals[2].address
+    from collections import Counter
+
+    seen = Counter()
+    for _ in range(10):
+        vs.increment_accum(1)
+        seen[vs.proposer.address] += 1
+    assert seen[heavy] == 8
+
+
+def test_hash_changes_with_membership():
+    vs, _ = make_validators(4)
+    h1 = vs.hash()
+    vs2, _ = make_validators(5)
+    assert h1 != vs2.hash()
+    assert len(h1) == 32
+
+
+def test_verify_commit_ok():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    commit = make_commit(vs, privs, height=5, round_=0, block_id=bid)
+    vs.verify_commit(CHAIN_ID, bid, 5, commit)  # no raise
+
+
+def test_verify_commit_insufficient_power():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    # only 2 of 4 sign -> 50% < 2/3... but make_commit needs maj23; build by hand
+    from tests.helpers import signed_vote
+    from tendermint_tpu.types import VOTE_TYPE_PRECOMMIT, Commit
+
+    votes = [None] * 4
+    for i in range(2):
+        votes[i] = signed_vote(privs[i], i, 5, 0, VOTE_TYPE_PRECOMMIT, bid)
+    commit = Commit(block_id=bid, precommits=votes)
+    with pytest.raises(ValidationError, match="insufficient"):
+        vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+
+def test_verify_commit_bad_signature():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    commit = make_commit(vs, privs, height=5, round_=0, block_id=bid)
+    # corrupt one signature
+    v = commit.precommits[0]
+    commit.precommits[0] = v.with_signature(bytes(64))
+    with pytest.raises(ValidationError, match="signature"):
+        vs.verify_commit(CHAIN_ID, bid, 5, commit)
+
+
+def test_verify_commit_wrong_height():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    commit = make_commit(vs, privs, height=5, round_=0, block_id=bid)
+    with pytest.raises(ValidationError):
+        vs.verify_commit(CHAIN_ID, bid, 6, commit)
+
+
+def test_verify_commit_any_small_change():
+    vs, privs = make_validators(4)
+    bid = make_block_id()
+    commit = make_commit(vs, privs, height=7, round_=0, block_id=bid)
+    # old set == new set works through verify_commit_any too
+    vs.verify_commit_any(vs, CHAIN_ID, bid, 7, commit)
+
+
+def test_apply_changes():
+    vs, privs = make_validators(4)
+    target = vs.validators[0]
+    vs.apply_changes([Validator(target.address, target.pub_key, 0)])
+    assert vs.size() == 3
+    assert not vs.has_address(target.address)
+    # update power
+    v1 = vs.validators[0]
+    vs.apply_changes([Validator(v1.address, v1.pub_key, 99)])
+    assert vs.get_by_address(v1.address)[1].voting_power == 99
+
+
+def test_duplicate_address_rejected():
+    vs, _ = make_validators(2)
+    with pytest.raises(ValidationError):
+        ValidatorSet(list(vs.validators) + [vs.validators[0]])
+
+
+def test_verify_commit_any_requires_new_set_quorum():
+    # Old set: 4 validators of 10. New set: same 4 plus a whale of 120.
+    # A commit signed by the original 4 has >2/3 of OLD power but only
+    # 40/160 of NEW power -> must be rejected (reference :340-346 rule).
+    from tests.helpers import det_priv_keys
+    from tendermint_tpu.types import PrivValidator
+
+    vs, privs = make_validators(4)
+    whale_priv = PrivValidator(det_priv_keys(5)[4])
+    new_vals = list(vs.validators) + [
+        Validator(whale_priv.address, whale_priv.pub_key, 120)
+    ]
+    new_vs = ValidatorSet(new_vals)
+    bid = make_block_id()
+    # commit shaped for the NEW set (5 slots), signed only by the old 4
+    from tests.helpers import signed_vote
+    from tendermint_tpu.types import VOTE_TYPE_PRECOMMIT, Commit
+
+    precommits = [None] * new_vs.size()
+    for i, val in enumerate(new_vs.validators):
+        idx, old = vs.get_by_address(val.address)
+        if old is None:
+            continue
+        p = next(p for p in privs if p.address == val.address)
+        precommits[i] = signed_vote(p, i, 9, 0, VOTE_TYPE_PRECOMMIT, bid)
+    commit = Commit(block_id=bid, precommits=precommits)
+    with pytest.raises(ValidationError, match="new voting power"):
+        vs.verify_commit_any(new_vs, CHAIN_ID, bid, 9, commit)

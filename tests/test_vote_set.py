@@ -1,0 +1,147 @@
+import pytest
+
+from tendermint_tpu.types import (
+    VOTE_TYPE_PRECOMMIT,
+    VOTE_TYPE_PREVOTE,
+    BlockID,
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteUnexpectedStep,
+    VoteSet,
+)
+from tests.helpers import (
+    CHAIN_ID,
+    byzantine_signed_vote,
+    make_block_id,
+    make_validators,
+    signed_vote,
+)
+
+
+def new_set(n=4, height=1, round_=0, type_=VOTE_TYPE_PREVOTE, power=10):
+    vs, privs = make_validators(n, power)
+    return VoteSet(CHAIN_ID, height, round_, type_, vs), privs
+
+
+def test_quorum_exact_two_thirds_plus_one():
+    # 4 validators x 10 power; quorum needs > 26.67 => 3 votes (30)
+    vote_set, privs = new_set()
+    bid = make_block_id()
+    for i in range(2):
+        vote_set.add_vote(signed_vote(privs[i], i, 1, 0, VOTE_TYPE_PREVOTE, bid))
+        assert not vote_set.has_two_thirds_majority()
+    vote_set.add_vote(signed_vote(privs[2], 2, 1, 0, VOTE_TYPE_PREVOTE, bid))
+    assert vote_set.has_two_thirds_majority()
+    assert vote_set.two_thirds_majority() == bid
+
+
+def test_nil_votes_count_toward_any_not_majority():
+    vote_set, privs = new_set()
+    nil = BlockID.zero()
+    for i in range(3):
+        vote_set.add_vote(signed_vote(privs[i], i, 1, 0, VOTE_TYPE_PREVOTE, nil))
+    assert vote_set.has_two_thirds_any()
+    assert vote_set.two_thirds_majority() == nil  # nil can also win a polka
+
+
+def test_split_votes_no_majority():
+    vote_set, privs = new_set()
+    a, b = make_block_id(b"a"), make_block_id(b"b")
+    vote_set.add_vote(signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, a))
+    vote_set.add_vote(signed_vote(privs[1], 1, 1, 0, VOTE_TYPE_PREVOTE, a))
+    vote_set.add_vote(signed_vote(privs[2], 2, 1, 0, VOTE_TYPE_PREVOTE, b))
+    vote_set.add_vote(signed_vote(privs[3], 3, 1, 0, VOTE_TYPE_PREVOTE, b))
+    assert vote_set.has_two_thirds_any()
+    assert not vote_set.has_two_thirds_majority()
+
+
+def test_duplicate_vote_not_added():
+    vote_set, privs = new_set()
+    bid = make_block_id()
+    v = signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, bid, timestamp=123)
+    assert vote_set.add_vote(v)
+    assert not vote_set.add_vote(v)
+
+
+def test_conflicting_vote_raises_evidence():
+    vote_set, privs = new_set()
+    a, b = make_block_id(b"a"), make_block_id(b"b")
+    vote_set.add_vote(byzantine_signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, a))
+    with pytest.raises(ErrVoteConflictingVotes) as ei:
+        vote_set.add_vote(byzantine_signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, b))
+    assert ei.value.vote_a.block_id == a
+    assert ei.value.vote_b.block_id == b
+
+
+def test_conflicting_vote_tracked_after_peer_maj23():
+    vote_set, privs = new_set()
+    a, b = make_block_id(b"a"), make_block_id(b"b")
+    vote_set.add_vote(byzantine_signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, a))
+    vote_set.set_peer_maj23("peer1", b)
+    # conflict still raises evidence, but the vote lands in block b's tally
+    with pytest.raises(ErrVoteConflictingVotes):
+        vote_set.add_vote(byzantine_signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, b))
+    ba = vote_set.bit_array_by_block_id(b)
+    assert ba is not None and ba.get(0)
+
+
+def test_wrong_height_round_type_rejected():
+    vote_set, privs = new_set(height=5, round_=2)
+    bid = make_block_id()
+    with pytest.raises(ErrVoteUnexpectedStep):
+        vote_set.add_vote(signed_vote(privs[0], 0, 4, 2, VOTE_TYPE_PREVOTE, bid))
+    with pytest.raises(ErrVoteUnexpectedStep):
+        vote_set.add_vote(signed_vote(privs[0], 0, 5, 1, VOTE_TYPE_PREVOTE, bid))
+    with pytest.raises(ErrVoteUnexpectedStep):
+        vote_set.add_vote(signed_vote(privs[0], 0, 5, 2, VOTE_TYPE_PRECOMMIT, bid))
+
+
+def test_wrong_address_rejected():
+    vote_set, privs = new_set()
+    bid = make_block_id()
+    v = signed_vote(privs[1], 0, 1, 0, VOTE_TYPE_PREVOTE, bid)  # wrong index
+    with pytest.raises(ErrVoteInvalidValidatorAddress):
+        vote_set.add_vote(v)
+
+
+def test_bad_signature_rejected():
+    vote_set, privs = new_set()
+    bid = make_block_id()
+    v = signed_vote(privs[0], 0, 1, 0, VOTE_TYPE_PREVOTE, bid)
+    with pytest.raises(ErrVoteInvalidSignature):
+        vote_set.add_vote(v.with_signature(bytes(64)))
+
+
+def test_make_commit():
+    vote_set, privs = new_set(type_=VOTE_TYPE_PRECOMMIT)
+    bid = make_block_id()
+    for i in range(3):
+        vote_set.add_vote(signed_vote(privs[i], i, 1, 0, VOTE_TYPE_PRECOMMIT, bid))
+    commit = vote_set.make_commit()
+    assert commit.block_id == bid
+    assert commit.size() == 4
+    assert sum(1 for v in commit.precommits if v is not None) == 3
+    commit.validate_basic()
+
+
+def test_make_commit_requires_majority():
+    vote_set, privs = new_set(type_=VOTE_TYPE_PRECOMMIT)
+    with pytest.raises(Exception):
+        vote_set.make_commit()
+
+
+def test_66_percent_is_not_enough():
+    # 3 validators of power 10, plus one of power 15: total 45.
+    # Two tens + the 15 = 35 > 30 OK; but exactly 2/3 (30) must fail.
+    from tendermint_tpu.types import Validator, ValidatorSet
+
+    vs, privs_all = make_validators(3, power=10)
+    # quorum needs > 20: two votes = 20 exactly -> NOT a majority
+    vote_set = VoteSet(CHAIN_ID, 1, 0, VOTE_TYPE_PREVOTE, vs)
+    bid = make_block_id()
+    vote_set.add_vote(signed_vote(privs_all[0], 0, 1, 0, VOTE_TYPE_PREVOTE, bid))
+    vote_set.add_vote(signed_vote(privs_all[1], 1, 1, 0, VOTE_TYPE_PREVOTE, bid))
+    assert not vote_set.has_two_thirds_majority()
+    vote_set.add_vote(signed_vote(privs_all[2], 2, 1, 0, VOTE_TYPE_PREVOTE, bid))
+    assert vote_set.has_two_thirds_majority()
