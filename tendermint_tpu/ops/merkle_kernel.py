@@ -1,0 +1,109 @@
+"""Device Merkle tree reduction (log-depth, batched SHA-256 inner nodes).
+
+Computes the same root as `tendermint_tpu.merkle.simple` (largest-power-of-two
+split rule) via an equivalent level-by-level pairing: at each level adjacent
+nodes pair into an inner hash and an unpaired trailing node is promoted
+unchanged. Each level is one batched 2-block SHA-256 over all pairs — the
+whole tree is log2(N) kernel steps (reference hot spots: `types/block.go:177`,
+`types/tx.go:33-46`, `types/part_set.go:95-122`).
+
+Inner-node messages (0x01 || left32 || right32 = 65 bytes) are assembled
+directly in u32 registers (byte-shift composition), so no host round-trip
+happens between levels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops.sha256_kernel import sha256_fixed2_from_words
+
+_B8 = np.uint32(8)
+_B24 = np.uint32(24)
+
+
+def _inner_node_words(L, R):
+    """Build the two 16-word SHA-256 blocks for H(0x01 || L || R).
+
+    L, R: (B, 8) u32 big-endian digest words. The 1-byte domain prefix shifts
+    every digest byte by one, so each message word mixes two source words.
+    """
+    w0 = []
+    w0.append(jnp.uint32(0x01000000) | (L[:, 0] >> _B8))
+    for i in range(1, 8):
+        w0.append((L[:, i - 1] << _B24) | (L[:, i] >> _B8))
+    w0.append((L[:, 7] << _B24) | (R[:, 0] >> _B8))
+    for i in range(1, 8):
+        w0.append((R[:, i - 1] << _B24) | (R[:, i] >> _B8))
+    block0 = jnp.stack(w0, axis=1)
+
+    B = L.shape[0]
+    zero = jnp.zeros((B,), dtype=jnp.uint32)
+    w1 = [(R[:, 7] << _B24) | jnp.uint32(0x00800000)]
+    w1 += [zero] * 14
+    w1.append(jnp.full((B,), np.uint32(65 * 8), dtype=jnp.uint32))
+    block1 = jnp.stack(w1, axis=1)
+    return block0, block1
+
+
+def inner_hash_device(L, R):
+    """(B,8),(B,8) -> (B,8): batched domain-separated inner-node hash."""
+    b0, b1 = _inner_node_words(L, R)
+    return sha256_fixed2_from_words(b0, b1)
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def _tree_reduce(leaves, count, levels: int):
+    """leaves: (P, 8) u32 with P = 2**levels; count: traced i32 valid prefix.
+    Returns (8,) root words."""
+    nodes = leaves
+    for _ in range(levels):
+        left = nodes[0::2]
+        right = nodes[1::2]
+        paired = inner_hash_device(left, right)
+        idx = jnp.arange(left.shape[0], dtype=jnp.int32)
+        # pair exists only if its right child is inside the valid prefix;
+        # an unpaired trailing node is promoted (== left child unchanged).
+        nodes = jnp.where((2 * idx + 1 < count)[:, None], paired, left)
+        count = (count + 1) // 2
+    return nodes[0]
+
+
+def merkle_root_from_leaf_words(leaf_digests, count=None):
+    """Root from device leaf hashes.
+
+    leaf_digests: (N, 8) u32 (already leaf-prefixed hashes). N is padded up to
+    the next power of two internally; `count` defaults to N.
+    """
+    leaf_digests = jnp.asarray(leaf_digests, dtype=jnp.uint32)
+    n = leaf_digests.shape[0]
+    if count is None:
+        count = n
+    P = 1
+    while P < n:
+        P *= 2
+    if P != n:
+        pad = jnp.zeros((P - n, 8), dtype=jnp.uint32)
+        leaf_digests = jnp.concatenate([leaf_digests, pad], axis=0)
+    levels = P.bit_length() - 1
+    return _tree_reduce(leaf_digests, jnp.asarray(count, dtype=jnp.int32), levels)
+
+
+def merkle_root_device(items: list[bytes]) -> bytes:
+    """Host convenience: full device tree build over raw byte items.
+
+    Bit-equal to `merkle.simple.simple_hash_from_byte_slices` (sha256 algo).
+    """
+    from tendermint_tpu.ops.padding import digests_to_bytes_be, pad_sha256
+    from tendermint_tpu.ops.sha256_kernel import sha256_batch_jax
+
+    if not items:
+        return b""
+    blocks, counts = pad_sha256([b"\x00" + x for x in items])
+    leaf_digests = sha256_batch_jax(blocks, counts)
+    root = merkle_root_from_leaf_words(leaf_digests)
+    return digests_to_bytes_be(np.asarray(root)[None, :])[0]

@@ -1,0 +1,97 @@
+"""Host-side message padding into fixed-shape u32 block arrays.
+
+Variable-length sign-bytes/leaves are padded to bucketed block counts so the
+device kernels see only static shapes (bucketing avoids one XLA recompile per
+message length — SURVEY.md §7 hard part 2). All functions return numpy arrays
+ready to ship to device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SHA256_BLOCK_BYTES = 64
+SHA512_BLOCK_BYTES = 128
+
+
+def n_blocks_sha256(msg_len: int) -> int:
+    """Blocks after MD padding (1 byte 0x80 + 8-byte BE length)."""
+    return (msg_len + 8) // 64 + 1
+
+
+def n_blocks_sha512(msg_len: int) -> int:
+    """Blocks after padding (1 byte 0x80 + 16-byte BE length)."""
+    return (msg_len + 16) // 128 + 1
+
+
+def bucket_blocks(n: int, buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32)) -> int:
+    """Smallest bucket >= n (shape-stable compilation)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    # beyond the largest bucket: round up to a multiple of it
+    top = buckets[-1]
+    return ((n + top - 1) // top) * top
+
+
+def _md_pad(msg: bytes, block: int, length_bytes: int, length_le: bool) -> bytes:
+    """Merkle-Damgård padding: 0x80, zeros, message bit-length."""
+    bitlen = len(msg) * 8
+    padded = msg + b"\x80"
+    rem = (len(padded) + length_bytes) % block
+    if rem:
+        padded += b"\x00" * (block - rem)
+    if length_le:
+        padded += bitlen.to_bytes(length_bytes, "little")
+    else:
+        padded += bitlen.to_bytes(length_bytes, "big")
+    return padded
+
+
+def pad_sha256(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """-> (blocks[B, max_blocks, 16] u32 big-endian words, n_blocks[B] i32)."""
+    padded = [_md_pad(m, 64, 8, length_le=False) for m in msgs]
+    counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
+    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)
+    for i, p in enumerate(padded):
+        words = np.frombuffer(p, dtype=">u4").astype(np.uint32)
+        out[i, : counts[i]] = words.reshape(-1, 16)
+    return out, counts
+
+
+def pad_sha512(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """-> (blocks[B, max_blocks, 32] u32: words 2i=hi, 2i+1=lo of 64-bit BE words,
+    n_blocks[B] i32)."""
+    padded = [_md_pad(m, 128, 16, length_le=False) for m in msgs]
+    counts = np.array([len(p) // 128 for p in padded], dtype=np.int32)
+    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    out = np.zeros((len(msgs), mb, 32), dtype=np.uint32)
+    for i, p in enumerate(padded):
+        words = np.frombuffer(p, dtype=">u4").astype(np.uint32)  # already hi,lo pairs
+        out[i, : counts[i]] = words.reshape(-1, 32)
+    return out, counts
+
+
+def pad_ripemd160(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:
+    """-> (blocks[B, max_blocks, 16] u32 little-endian words, n_blocks[B] i32)."""
+    padded = [_md_pad(m, 64, 8, length_le=True) for m in msgs]
+    counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
+    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)
+    for i, p in enumerate(padded):
+        words = np.frombuffer(p, dtype="<u4").astype(np.uint32)
+        out[i, : counts[i]] = words.reshape(-1, 16)
+    return out, counts
+
+
+def digests_to_bytes_be(digests: np.ndarray) -> list[bytes]:
+    """(B, W) u32 big-endian word digests -> list of byte digests."""
+    arr = np.asarray(digests, dtype=np.uint32)
+    return [w.astype(">u4").tobytes() for w in arr]
+
+
+def digests_to_bytes_le(digests: np.ndarray) -> list[bytes]:
+    """(B, W) u32 little-endian word digests (RIPEMD-160) -> bytes."""
+    arr = np.asarray(digests, dtype=np.uint32)
+    return [w.astype("<u4").tobytes() for w in arr]

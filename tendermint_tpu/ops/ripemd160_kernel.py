@@ -1,0 +1,137 @@
+"""Batched RIPEMD-160 in JAX (u32 lanes).
+
+The reference's Merkle/part/address hash (`types/part_set.go:36-40`,
+`docs/specification/merkle.rst`). Kept as the bit-compatibility variant next
+to the SHA-256 target kernel. Dual-line ARX structure (ISO/IEC 10118-3): each
+of the 5 round groups runs as a 16-step `lax.scan` with the group's static
+boolean function; message-word selection uses per-step gathers.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tendermint_tpu.ops.sha256_kernel import _icbrt
+
+# Message word order per 16-step round group (left line, right line).
+_RL = [
+    list(range(16)),
+    [7, 4, 13, 1, 10, 6, 15, 3, 12, 0, 9, 5, 2, 14, 11, 8],
+    [3, 10, 14, 4, 9, 15, 8, 1, 2, 7, 0, 6, 13, 11, 5, 12],
+    [1, 9, 11, 10, 0, 8, 12, 4, 13, 3, 7, 15, 14, 5, 6, 2],
+    [4, 0, 5, 9, 7, 12, 2, 10, 14, 1, 3, 8, 11, 6, 15, 13],
+]
+_RR = [
+    [5, 14, 7, 0, 9, 2, 11, 4, 13, 6, 15, 8, 1, 10, 3, 12],
+    [6, 11, 3, 7, 0, 13, 5, 10, 14, 15, 8, 12, 4, 9, 1, 2],
+    [15, 5, 1, 3, 7, 14, 6, 9, 11, 8, 12, 2, 10, 0, 4, 13],
+    [8, 6, 4, 1, 3, 11, 15, 0, 5, 12, 2, 13, 9, 7, 10, 14],
+    [12, 15, 10, 4, 1, 5, 8, 7, 6, 2, 13, 14, 0, 3, 9, 11],
+]
+# Rotation amounts.
+_SL = [
+    [11, 14, 15, 12, 5, 8, 7, 9, 11, 13, 14, 15, 6, 7, 9, 8],
+    [7, 6, 8, 13, 11, 9, 7, 15, 7, 12, 15, 9, 11, 7, 13, 12],
+    [11, 13, 6, 7, 14, 9, 13, 15, 14, 8, 13, 6, 5, 12, 7, 5],
+    [11, 12, 14, 15, 14, 15, 9, 8, 9, 14, 5, 6, 8, 6, 5, 12],
+    [9, 15, 5, 11, 6, 8, 13, 12, 5, 12, 13, 14, 11, 8, 5, 6],
+]
+_SR = [
+    [8, 9, 9, 11, 13, 15, 15, 5, 7, 7, 8, 11, 14, 14, 12, 6],
+    [9, 13, 15, 7, 12, 8, 9, 11, 7, 7, 12, 7, 6, 15, 13, 11],
+    [9, 7, 15, 11, 8, 6, 6, 14, 12, 13, 5, 14, 13, 13, 7, 5],
+    [15, 5, 8, 11, 14, 14, 6, 14, 6, 9, 12, 9, 12, 5, 15, 8],
+    [8, 5, 12, 9, 12, 5, 14, 6, 8, 13, 6, 5, 15, 13, 11, 11],
+]
+# Round constants: left = floor(2^30*sqrt(2,3,5,7)), right = floor(2^30*cbrt(2,3,5,7)).
+_KL = [0] + [math.isqrt(p << 60) for p in (2, 3, 5, 7)]
+_KR = [_icbrt(p << 90) for p in (2, 3, 5, 7)] + [0]
+
+_H0 = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0], dtype=np.uint32)
+
+
+def _rotl_dyn(x, n):
+    """Rotate-left by a per-step traced amount (1 <= n <= 31)."""
+    n = n.astype(jnp.uint32)
+    return (x << n) | (x >> (jnp.uint32(32) - n))
+
+
+def _rotl10(x):
+    return (x << jnp.uint32(10)) | (x >> jnp.uint32(22))
+
+
+def _f(j: int, x, y, z):
+    if j == 0:
+        return x ^ y ^ z
+    if j == 1:
+        return (x & y) | (~x & z)
+    if j == 2:
+        return (x | ~y) ^ z
+    if j == 3:
+        return (x & z) | (y & ~z)
+    return x ^ (y | ~z)
+
+
+def _line(rnd_fns, R_TAB, S_TAB, K_TAB, X, state5):
+    """Run one full 80-step line. X: (B, 16); state5: 5×(B,)."""
+    a, b, c, d, e = state5
+    for grp in range(5):
+        xs = (
+            jnp.asarray(R_TAB[grp], dtype=jnp.int32),
+            jnp.asarray(S_TAB[grp], dtype=jnp.uint32),
+        )
+        k = np.uint32(K_TAB[grp])
+        fn = rnd_fns[grp]
+
+        def step(regs, xs, fn=fn, k=k):
+            a, b, c, d, e = regs
+            r_idx, s_amt = xs
+            t = _rotl_dyn(a + fn(b, c, d) + X[:, r_idx] + k, s_amt) + e
+            return (e, t, b, _rotl10(c), d), None
+
+        (a, b, c, d, e), _ = lax.scan(step, (a, b, c, d, e), xs)
+        # note: step returns (a', b', c', d', e') = (e, t, b, rotl10(c), d)
+    return a, b, c, d, e
+
+
+def _compress160(state, w_block):
+    """state: (B, 5) u32; w_block: (B, 16) u32 little-endian words."""
+    left_fns = [lambda x, y, z, j=j: _f(j, x, y, z) for j in range(5)]
+    right_fns = [lambda x, y, z, j=j: _f(4 - j, x, y, z) for j in range(5)]
+    init = tuple(state[:, i] for i in range(5))
+    al, bl, cl, dl, el = _line(left_fns, _RL, _SL, _KL, w_block, init)
+    ar, br, cr, dr, er = _line(right_fns, _RR, _SR, _KR, w_block, init)
+    h0, h1, h2, h3, h4 = init
+    return jnp.stack(
+        [h1 + cl + dr, h2 + dl + er, h3 + el + ar, h4 + al + br, h0 + bl + cr],
+        axis=1,
+    )
+
+
+@partial(jax.jit, static_argnames=("max_blocks",))
+def _ripemd160_masked(blocks, n_blocks, max_blocks: int):
+    B = blocks.shape[0]
+    state0 = jnp.broadcast_to(jnp.asarray(_H0), (B, 5)).astype(jnp.uint32)
+
+    def block_step(state, xs):
+        w_block, j = xs
+        new_state = _compress160(state, w_block)
+        return jnp.where((j < n_blocks)[:, None], new_state, state), None
+
+    xs = (jnp.swapaxes(blocks, 0, 1), jnp.arange(max_blocks, dtype=jnp.int32))
+    state, _ = lax.scan(block_step, state0, xs)
+    return state
+
+
+def ripemd160_batch_jax(blocks, n_blocks):
+    """blocks: (B, max_blocks, 16) u32 LE words; n_blocks: (B,) i32.
+    Returns (B, 5) u32 digests (little-endian words)."""
+    blocks = jnp.asarray(blocks, dtype=jnp.uint32)
+    n_blocks = jnp.asarray(n_blocks, dtype=jnp.int32)
+    return _ripemd160_masked(blocks, n_blocks, blocks.shape[1])

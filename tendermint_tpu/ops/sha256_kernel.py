@@ -1,0 +1,133 @@
+"""Batched SHA-256 in JAX (u32 lanes, batch over the trailing dim inside the
+compression, leading dim at the API).
+
+Replaces host hashlib at the TreeHasher seam for bulk tree builds
+(reference call sites: `types/tx.go:33-46`, `types/part_set.go:95-122`).
+The round structure is serial (FIPS 180-4) and expressed as `lax.scan` —
+compiler-friendly control flow, no giant unrolled graphs — so all throughput
+comes from the batch dimension, which the VPU vectorizes and the mesh shards.
+
+Constants are generated from integer square/cube roots of the first primes —
+no transcribed magic tables.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _first_primes(n: int) -> list[int]:
+    primes, c = [], 2
+    while len(primes) < n:
+        if all(c % p for p in primes):
+            primes.append(c)
+        c += 1
+    return primes
+
+
+def _icbrt(n: int) -> int:
+    """Exact integer cube root (floor)."""
+    x = int(round(n ** (1 / 3)))
+    while x**3 > n:
+        x -= 1
+    while (x + 1) ** 3 <= n:
+        x += 1
+    return x
+
+
+def _frac_root_bits(p: int, root: int, bits: int) -> int:
+    """floor(frac(p^(1/root)) * 2^bits), computed exactly in integers."""
+    scaled = p << (root * bits)
+    r = math.isqrt(scaled) if root == 2 else _icbrt(scaled)
+    return r - ((r >> bits) << bits)
+
+
+_PRIMES64 = _first_primes(64)
+SHA256_H0 = np.array([_frac_root_bits(p, 2, 32) for p in _PRIMES64[:8]], dtype=np.uint32)
+SHA256_K = np.array([_frac_root_bits(p, 3, 32) for p in _PRIMES64], dtype=np.uint32)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _compress(state, w_block):
+    """One SHA-256 compression. state: (B, 8) u32; w_block: (B, 16) u32."""
+    w0 = w_block.T  # (16, B)
+
+    def sched_step(window, _):
+        # window holds w[t-16..t-1]; W[t] = w[t-16]+σ0(w[t-15])+w[t-7]+σ1(w[t-2])
+        s0 = _rotr(window[1], 7) ^ _rotr(window[1], 18) ^ (window[1] >> np.uint32(3))
+        s1 = _rotr(window[14], 17) ^ _rotr(window[14], 19) ^ (window[14] >> np.uint32(10))
+        new = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], new[None]], axis=0), new
+
+    _, w_rest = lax.scan(sched_step, w0, None, length=48)
+    W = jnp.concatenate([w0, w_rest], axis=0)  # (64, B)
+
+    def round_step(regs, xs):
+        a, b, c, d, e, f, g, h = regs
+        k, w = xs
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + k + w
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    init = tuple(state[:, i] for i in range(8))
+    regs, _ = lax.scan(round_step, init, (jnp.asarray(SHA256_K), W))
+    return jnp.stack(regs, axis=1) + state
+
+
+@partial(jax.jit, static_argnames=("max_blocks",))
+def _sha256_masked(blocks, n_blocks, max_blocks: int):
+    B = blocks.shape[0]
+    state0 = jnp.broadcast_to(jnp.asarray(SHA256_H0), (B, 8)).astype(jnp.uint32)
+
+    def block_step(state, xs):
+        w_block, j = xs
+        new_state = _compress(state, w_block)
+        return jnp.where((j < n_blocks)[:, None], new_state, state), None
+
+    xs = (jnp.swapaxes(blocks, 0, 1), jnp.arange(max_blocks, dtype=jnp.int32))
+    state, _ = lax.scan(block_step, state0, xs)
+    return state
+
+
+def sha256_batch_jax(blocks, n_blocks):
+    """Digest a batch of padded messages.
+
+    blocks: (B, max_blocks, 16) u32 BE words; n_blocks: (B,) i32.
+    Returns (B, 8) u32 digests. Messages shorter than max_blocks are masked
+    (their state freezes after their last real block).
+    """
+    blocks = jnp.asarray(blocks, dtype=jnp.uint32)
+    n_blocks = jnp.asarray(n_blocks, dtype=jnp.int32)
+    return _sha256_masked(blocks, n_blocks, blocks.shape[1])
+
+
+def sha256_fixed2_from_words(w0, w1):
+    """Digest exactly-2-block messages given precomputed word arrays
+    ((B,16) each) — the Merkle inner-node fast path (no masking)."""
+    B = w0.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(SHA256_H0), (B, 8)).astype(jnp.uint32)
+    state = _compress(state, w0)
+    return _compress(state, w1)
+
+
+def sha256_digest_bytes(msgs: list[bytes]) -> list[bytes]:
+    """Convenience host API: pad → device hash → bytes."""
+    from tendermint_tpu.ops.padding import digests_to_bytes_be, pad_sha256
+
+    if not msgs:
+        return []
+    blocks, counts = pad_sha256(msgs)
+    return digests_to_bytes_be(np.asarray(sha256_batch_jax(blocks, counts)))

@@ -1,0 +1,151 @@
+"""Batched SHA-512 in JAX with 64-bit words emulated as (hi, lo) u32 pairs.
+
+Needed on-device for ed25519: h = SHA-512(R || A || M) feeds the batch
+verifier (SURVEY.md §7 hard part 2). TPUs have no native u64 vector path, so
+every 64-bit op is synthesized from u32 adds/shifts/logicals; rounds run as
+`lax.scan` and throughput comes purely from the batch dimension.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tendermint_tpu.ops.sha256_kernel import _first_primes, _frac_root_bits
+
+_PRIMES80 = _first_primes(80)
+# (hi, lo) u32 pairs for H0 (sqrt of first 8 primes) and K (cbrt of first 80).
+_H0_64 = [_frac_root_bits(p, 2, 64) for p in _PRIMES80[:8]]
+_K_64 = [_frac_root_bits(p, 3, 64) for p in _PRIMES80]
+SHA512_H0_HI = np.array([v >> 32 for v in _H0_64], dtype=np.uint32)
+SHA512_H0_LO = np.array([v & 0xFFFFFFFF for v in _H0_64], dtype=np.uint32)
+SHA512_K_HI = np.array([v >> 32 for v in _K_64], dtype=np.uint32)
+SHA512_K_LO = np.array([v & 0xFFFFFFFF for v in _K_64], dtype=np.uint32)
+
+
+# -- 64-bit ops on (hi, lo) u32 pairs -----------------------------------------
+
+
+def _add64(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _add64_many(*xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = _add64(acc, x)
+    return acc
+
+
+def _xor64(a, b):
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _and64(a, b):
+    return (a[0] & b[0], a[1] & b[1])
+
+
+def _not64(a):
+    return (~a[0], ~a[1])
+
+
+def _rotr64(x, n: int):
+    hi, lo = x
+    if n == 32:
+        return (lo, hi)
+    if n < 32:
+        nn = np.uint32(n)
+        inv = np.uint32(32 - n)
+        return ((hi >> nn) | (lo << inv), (lo >> nn) | (hi << inv))
+    m = np.uint32(n - 32)
+    inv = np.uint32(64 - n)  # = 32 - m
+    return ((lo >> m) | (hi << inv), (hi >> m) | (lo << inv))
+
+
+def _shr64(x, n: int):
+    hi, lo = x
+    if n < 32:
+        nn = np.uint32(n)
+        inv = np.uint32(32 - n)
+        return (hi >> nn, (lo >> nn) | (hi << inv))
+    m = np.uint32(n - 32)
+    return (jnp.zeros_like(hi), hi >> m)
+
+
+def _compress512(state, w_block):
+    """state: (B, 16) u32 (hi,lo interleaved per 64-bit reg); w_block: (B, 32) u32."""
+    whi0 = w_block[:, 0::2].T  # (16, B)
+    wlo0 = w_block[:, 1::2].T
+
+    def sched_step(carry, _):
+        whi, wlo = carry  # (16, B) each: w[t-16..t-1]
+        x = (whi[1], wlo[1])
+        s0 = _xor64(_xor64(_rotr64(x, 1), _rotr64(x, 8)), _shr64(x, 7))
+        y = (whi[14], wlo[14])
+        s1 = _xor64(_xor64(_rotr64(y, 19), _rotr64(y, 61)), _shr64(y, 6))
+        new = _add64_many((whi[0], wlo[0]), s0, (whi[9], wlo[9]), s1)
+        whi = jnp.concatenate([whi[1:], new[0][None]], axis=0)
+        wlo = jnp.concatenate([wlo[1:], new[1][None]], axis=0)
+        return (whi, wlo), new
+
+    _, w_rest = lax.scan(sched_step, (whi0, wlo0), None, length=64)
+    W_hi = jnp.concatenate([whi0, w_rest[0]], axis=0)  # (80, B)
+    W_lo = jnp.concatenate([wlo0, w_rest[1]], axis=0)
+
+    def round_step(regs, xs):
+        a, b, c, d, e, f, g, h = regs
+        k_hi, k_lo, w_hi, w_lo = xs
+        kt = (jnp.broadcast_to(k_hi, a[0].shape), jnp.broadcast_to(k_lo, a[1].shape))
+        S1 = _xor64(_xor64(_rotr64(e, 14), _rotr64(e, 18)), _rotr64(e, 41))
+        ch = _xor64(_and64(e, f), _and64(_not64(e), g))
+        t1 = _add64_many(h, S1, ch, kt, (w_hi, w_lo))
+        S0 = _xor64(_xor64(_rotr64(a, 28), _rotr64(a, 34)), _rotr64(a, 39))
+        maj = _xor64(_xor64(_and64(a, b), _and64(a, c)), _and64(b, c))
+        t2 = _add64(S0, maj)
+        return (_add64(t1, t2), a, b, c, _add64(d, t1), e, f, g), None
+
+    init = tuple((state[:, 2 * i], state[:, 2 * i + 1]) for i in range(8))
+    regs, _ = lax.scan(
+        round_step,
+        init,
+        (
+            jnp.asarray(SHA512_K_HI),
+            jnp.asarray(SHA512_K_LO),
+            W_hi,
+            W_lo,
+        ),
+    )
+    out = [_add64(r, s) for r, s in zip(regs, init)]
+    return jnp.stack([part for pair in out for part in pair], axis=1)
+
+
+@partial(jax.jit, static_argnames=("max_blocks",))
+def _sha512_masked(blocks, n_blocks, max_blocks: int):
+    B = blocks.shape[0]
+    h0 = np.empty(16, dtype=np.uint32)
+    h0[0::2] = SHA512_H0_HI
+    h0[1::2] = SHA512_H0_LO
+    state0 = jnp.broadcast_to(jnp.asarray(h0), (B, 16)).astype(jnp.uint32)
+
+    def block_step(state, xs):
+        w_block, j = xs
+        new_state = _compress512(state, w_block)
+        return jnp.where((j < n_blocks)[:, None], new_state, state), None
+
+    xs = (jnp.swapaxes(blocks, 0, 1), jnp.arange(max_blocks, dtype=jnp.int32))
+    state, _ = lax.scan(block_step, state0, xs)
+    return state
+
+
+def sha512_batch_jax(blocks, n_blocks):
+    """blocks: (B, max_blocks, 32) u32 (64-bit BE words as hi,lo pairs);
+    n_blocks: (B,) i32. Returns (B, 16) u32 = the 64-byte digests as BE u32."""
+    blocks = jnp.asarray(blocks, dtype=jnp.uint32)
+    n_blocks = jnp.asarray(n_blocks, dtype=jnp.int32)
+    return _sha512_masked(blocks, n_blocks, blocks.shape[1])

@@ -1,11 +1,20 @@
 """Test environment: force JAX onto a virtual 8-device CPU mesh so multi-chip
 sharding paths compile and run without TPU hardware (the driver separately
-dry-runs multi-chip via __graft_entry__.dryrun_multichip)."""
+dry-runs multi-chip via __graft_entry__.dryrun_multichip).
+
+Note: this machine's axon sitecustomize registers the TPU plugin and
+overwrites `jax_platforms` — the env var alone is not enough, so we also
+update the config after importing jax (before any backend initialization).
+"""
 
 import os
 
-# Must be set before jax is imported anywhere.
+# Must be set before jax initializes a backend.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,87 @@
+"""Device hash kernels cross-validated bit-exactly against hashlib."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.merkle import simple_hash_from_byte_slices
+from tendermint_tpu.ops import (
+    merkle_root_device,
+    ripemd160_batch_jax,
+    sha256_batch_jax,
+    sha256_digest_bytes,
+    sha512_batch_jax,
+)
+from tendermint_tpu.ops.padding import (
+    digests_to_bytes_be,
+    digests_to_bytes_le,
+    pad_ripemd160,
+    pad_sha256,
+    pad_sha512,
+)
+
+LENGTHS = [0, 1, 3, 31, 32, 55, 56, 63, 64, 65, 111, 112, 127, 128, 129, 200, 300]
+
+
+def msgs_of_lengths():
+    rng = np.random.RandomState(7)
+    return [rng.bytes(n) for n in LENGTHS]
+
+
+def test_sha256_matches_hashlib():
+    msgs = msgs_of_lengths()
+    blocks, counts = pad_sha256(msgs)
+    got = digests_to_bytes_be(np.asarray(sha256_batch_jax(blocks, counts)))
+    want = [hashlib.sha256(m).digest() for m in msgs]
+    assert got == want
+
+
+def test_sha256_convenience_api():
+    msgs = [b"", b"abc", b"x" * 1000]
+    assert sha256_digest_bytes(msgs) == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_sha512_matches_hashlib():
+    msgs = msgs_of_lengths()
+    blocks, counts = pad_sha512(msgs)
+    out = np.asarray(sha512_batch_jax(blocks, counts))  # (B, 16) u32
+    got = digests_to_bytes_be(out)
+    want = [hashlib.sha512(m).digest() for m in msgs]
+    assert got == want
+
+
+def test_ripemd160_matches_hashlib():
+    msgs = msgs_of_lengths()
+    blocks, counts = pad_ripemd160(msgs)
+    out = np.asarray(ripemd160_batch_jax(blocks, counts))
+    got = digests_to_bytes_le(out)
+    want = []
+    for m in msgs:
+        h = hashlib.new("ripemd160")
+        h.update(m)
+        want.append(h.digest())
+    assert got == want
+
+
+def test_mixed_length_bucketing_masks_correctly():
+    # same batch, very different block counts: masking must freeze short msgs
+    msgs = [b"a", b"b" * 500, b"c" * 10, b"d" * 250]
+    blocks, counts = pad_sha256(msgs, max_blocks=16)
+    got = digests_to_bytes_be(np.asarray(sha256_batch_jax(blocks, counts)))
+    assert got == [hashlib.sha256(m).digest() for m in msgs]
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 6, 7, 8, 9, 11, 16, 17, 33, 100, 255, 256])
+def test_merkle_root_device_matches_host(n):
+    items = [f"leaf-{i}".encode() * (i % 5 + 1) for i in range(n)]
+    assert merkle_root_device(items) == simple_hash_from_byte_slices(items)
+
+
+def test_merkle_empty():
+    assert merkle_root_device([]) == b""
+
+
+def test_merkle_device_large_pow2():
+    items = [i.to_bytes(8, "big") for i in range(1024)]
+    assert merkle_root_device(items) == simple_hash_from_byte_slices(items)
