@@ -20,20 +20,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tendermint_tpu.merkle.simple import INNER_PREFIX, LEAF_PREFIX
 from tendermint_tpu.ops.sha256_kernel import sha256_fixed2_from_words
 
 _B8 = np.uint32(8)
 _B24 = np.uint32(24)
+# INNER_PREFIX byte placed in the top byte of the first message word.
+_INNER_PREFIX_WORD = np.uint32(INNER_PREFIX[0] << 24)
 
 
 def _inner_node_words(L, R):
-    """Build the two 16-word SHA-256 blocks for H(0x01 || L || R).
+    """Build the two 16-word SHA-256 blocks for H(INNER_PREFIX || L || R).
 
     L, R: (B, 8) u32 big-endian digest words. The 1-byte domain prefix shifts
     every digest byte by one, so each message word mixes two source words.
     """
     w0 = []
-    w0.append(jnp.uint32(0x01000000) | (L[:, 0] >> _B8))
+    w0.append(jnp.uint32(_INNER_PREFIX_WORD) | (L[:, 0] >> _B8))
     for i in range(1, 8):
         w0.append((L[:, i - 1] << _B24) | (L[:, i] >> _B8))
     w0.append((L[:, 7] << _B24) | (R[:, 0] >> _B8))
@@ -81,6 +84,10 @@ def merkle_root_from_leaf_words(leaf_digests, count=None):
     """
     leaf_digests = jnp.asarray(leaf_digests, dtype=jnp.uint32)
     n = leaf_digests.shape[0]
+    if n == 0:
+        raise ValueError(
+            "empty leaf batch has no root (host simple_hash_from_hashes([]) is b'')"
+        )
     if count is None:
         count = n
     P = 1
@@ -103,7 +110,7 @@ def merkle_root_device(items: list[bytes]) -> bytes:
 
     if not items:
         return b""
-    blocks, counts = pad_sha256([b"\x00" + x for x in items])
+    blocks, counts = pad_sha256([LEAF_PREFIX + x for x in items])
     leaf_digests = sha256_batch_jax(blocks, counts)
     root = merkle_root_from_leaf_words(leaf_digests)
     return digests_to_bytes_be(np.asarray(root)[None, :])[0]

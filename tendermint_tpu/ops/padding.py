@@ -14,16 +14,6 @@ SHA256_BLOCK_BYTES = 64
 SHA512_BLOCK_BYTES = 128
 
 
-def n_blocks_sha256(msg_len: int) -> int:
-    """Blocks after MD padding (1 byte 0x80 + 8-byte BE length)."""
-    return (msg_len + 8) // 64 + 1
-
-
-def n_blocks_sha512(msg_len: int) -> int:
-    """Blocks after padding (1 byte 0x80 + 16-byte BE length)."""
-    return (msg_len + 16) // 128 + 1
-
-
 def bucket_blocks(n: int, buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32)) -> int:
     """Smallest bucket >= n (shape-stable compilation)."""
     for b in buckets:
@@ -52,7 +42,7 @@ def pad_sha256(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.nda
     """-> (blocks[B, max_blocks, 16] u32 big-endian words, n_blocks[B] i32)."""
     padded = [_md_pad(m, 64, 8, length_le=False) for m in msgs]
     counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
-    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max(initial=1)))
     out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)
     for i, p in enumerate(padded):
         words = np.frombuffer(p, dtype=">u4").astype(np.uint32)
@@ -65,7 +55,7 @@ def pad_sha512(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.nda
     n_blocks[B] i32)."""
     padded = [_md_pad(m, 128, 16, length_le=False) for m in msgs]
     counts = np.array([len(p) // 128 for p in padded], dtype=np.int32)
-    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max(initial=1)))
     out = np.zeros((len(msgs), mb, 32), dtype=np.uint32)
     for i, p in enumerate(padded):
         words = np.frombuffer(p, dtype=">u4").astype(np.uint32)  # already hi,lo pairs
@@ -77,7 +67,7 @@ def pad_ripemd160(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.
     """-> (blocks[B, max_blocks, 16] u32 little-endian words, n_blocks[B] i32)."""
     padded = [_md_pad(m, 64, 8, length_le=True) for m in msgs]
     counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
-    mb = max_blocks if max_blocks is not None else int(counts.max(initial=1))
+    mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max(initial=1)))
     out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)
     for i, p in enumerate(padded):
         words = np.frombuffer(p, dtype="<u4").astype(np.uint32)

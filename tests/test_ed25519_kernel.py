@@ -1,0 +1,221 @@
+"""Device ed25519 batch verifier vs host reference + RFC 8032 vectors.
+
+Covers SURVEY.md §7 hard part #1 validation strategy: CPU reference
+cross-check, RFC 8032 vectors, and planted-bad-signature localization.
+"""
+
+import hashlib
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.keys import gen_priv_key
+from tendermint_tpu.ops import ed25519_kernel as ed
+
+
+def _fe(x: int):
+    return jnp.asarray(ed._int_to_limbs(x))[None, :]
+
+
+def _to_int(limbs) -> int:
+    return ed._limbs_to_int(np.asarray(limbs)[0])
+
+
+@jax.jit
+def _field_ops(a, b):
+    return (
+        ed.fe_canon(ed.fe_mul(a, b)),
+        ed.fe_canon(ed.fe_sub(a, b)),
+        ed.fe_canon(ed.fe_invert(a)),
+        ed.fe_to_bytes(a),
+        ed.fe_canon(ed.bytes_to_fe(ed.fe_to_bytes(b).astype(jnp.uint8))),
+    )
+
+
+class TestFieldArithmetic:
+    def test_random_and_edge_values(self):
+        rng = random.Random(7)
+        cases = [
+            (0, 1),
+            (1, 1),
+            (ed.P - 1, ed.P - 1),
+            (ed.P - 19, 2**255 % ed.P),
+            (2**254, 2**253 + 5),
+        ] + [(rng.randrange(ed.P), rng.randrange(ed.P)) for _ in range(27)]
+        A = jnp.asarray(np.stack([ed._int_to_limbs(a) for a, _ in cases]))
+        B = jnp.asarray(np.stack([ed._int_to_limbs(b) for _, b in cases]))
+        mul, sub, inv, tb, rt = (np.asarray(x) for x in _field_ops(A, B))
+        for i, (a, b) in enumerate(cases):
+            assert ed._limbs_to_int(mul[i]) == a * b % ed.P
+            assert ed._limbs_to_int(sub[i]) == (a - b) % ed.P
+            if a != 0:
+                assert ed._limbs_to_int(inv[i]) == pow(a, ed.P - 2, ed.P)
+            assert int.from_bytes(bytes(tb[i].tolist()), "little") == a
+            assert ed._limbs_to_int(rt[i]) == b  # bytes round-trip
+
+    def test_loose_limbs_stay_in_mul_bounds(self):
+        # After fe_carry, limbs must be small enough that fe_mul's 20-term
+        # column sums cannot overflow int32 (|limb| < ~2^13.7).
+        rng = random.Random(3)
+        vals = np.asarray(
+            [[rng.randrange(-(2**29), 2**29) for _ in range(ed.NLIMBS)] for _ in range(64)],
+            dtype=np.int32,
+        )
+        out = np.asarray(jax.jit(ed.fe_carry)(jnp.asarray(vals)))
+        assert np.abs(out).max() < 2**14
+
+
+# -- python affine-Edwards reference ------------------------------------------
+
+
+def _ref_add(p, q):
+    x1, y1 = p
+    x2, y2 = q
+    k = ed.D * x1 * x2 * y1 * y2 % ed.P
+    x3 = (x1 * y2 + x2 * y1) * pow(1 + k, ed.P - 2, ed.P) % ed.P
+    y3 = (y1 * y2 + x1 * x2) * pow(1 - k, ed.P - 2, ed.P) % ed.P
+    return x3, y3
+
+
+def _ref_mul(k, p):
+    acc = (0, 1)
+    while k:
+        if k & 1:
+            acc = _ref_add(acc, p)
+        p = _ref_add(p, p)
+        k >>= 1
+    return acc
+
+
+def _pt_dev(p):
+    x, y = p
+    return tuple(_fe(v) for v in (x, y, 1, x * y % ed.P))
+
+
+def _bits(k):
+    return jnp.asarray(
+        [[(k >> i) & 1 for i in range(ed.SCALAR_BITS)]], dtype=jnp.int32
+    )
+
+
+@jax.jit
+def _affine(pt):
+    x, y, z, _ = pt
+    zi = ed.fe_invert(z)
+    return ed.fe_canon(ed.fe_mul(x, zi)), ed.fe_canon(ed.fe_mul(y, zi))
+
+
+class TestPointOps:
+    def test_add_double_vs_reference(self):
+        rng = random.Random(11)
+        B = (ed.BX, ed.BY)
+        p = _ref_mul(rng.randrange(ed.L), B)
+        q = _ref_mul(rng.randrange(ed.L), B)
+
+        @jax.jit
+        def run(pd, qd):
+            return _affine(ed.pt_add(pd, qd)) + _affine(ed.pt_double(pd))
+
+        ax, ay, dx, dy = run(_pt_dev(p), _pt_dev(q))
+        assert (_to_int(ax), _to_int(ay)) == _ref_add(p, q)
+        assert (_to_int(dx), _to_int(dy)) == _ref_add(p, p)
+
+    def test_double_scalar_mul(self):
+        rng = random.Random(13)
+        B = (ed.BX, ed.BY)
+        a = rng.randrange(ed.L)
+        s, h = rng.randrange(ed.L), rng.randrange(ed.L)
+        A = _ref_mul(a, B)
+        expect = _ref_mul((s - h * a) % ed.L, B)
+
+        @jax.jit
+        def run(sb, hb, bp, ap):
+            return _affine(ed.double_scalar_mul(sb, bp, hb, ed.pt_neg(ap)))
+
+        gx, gy = run(_bits(s), _bits(h), _pt_dev(B), _pt_dev(A))
+        assert (_to_int(gx), _to_int(gy)) == expect
+
+
+class TestDecompress:
+    def test_valid_and_invalid_encodings(self):
+        rng = random.Random(17)
+        B = (ed.BX, ed.BY)
+        goods = [_ref_mul(rng.randrange(ed.L), B) for _ in range(4)]
+
+        def encode(p):
+            x, y = p
+            enc = bytearray(y.to_bytes(32, "little"))
+            enc[31] |= (x & 1) << 7
+            return bytes(enc)
+
+        encs = [encode(p) for p in goods]
+        encs.append((ed.P + 3).to_bytes(32, "little"))  # non-canonical y
+        encs.append((2).to_bytes(32, "little"))  # y=2 is not on the curve
+        arr = jnp.asarray(np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(-1, 32))
+        (x, y, z, t), ok = jax.jit(ed.pt_decompress)(arr)
+        ok = np.asarray(ok)
+        assert ok.tolist() == [True] * 4 + [False, False]
+        xs, ys = (np.asarray(v) for v in _affine((x, y, z, t)))
+        for i, p in enumerate(goods):
+            assert ed._limbs_to_int(xs[i]) == p[0]
+            assert ed._limbs_to_int(ys[i]) == p[1]
+
+
+class TestBatchVerify:
+    def test_against_host_with_planted_failures(self):
+        privs = [gen_priv_key(bytes([i]) * 32) for i in range(12)]
+        msgs = [bytes([i]) * (5 + 3 * i) for i in range(12)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        pubs = [p.pub_key.data for p in privs]
+        # plant failures: bad sig byte, bad msg, swapped pubkey, bad length
+        sigs[2] = sigs[2][:5] + bytes([sigs[2][5] ^ 0xFF]) + sigs[2][6:]
+        msgs[5] = msgs[5] + b"x"
+        pubs[8], pubs[9] = pubs[9], pubs[8]
+        sigs[11] = sigs[11][:40]
+        verdict = ed.batch_verify(pubs, msgs, sigs)
+        expect = [i not in (2, 5, 8, 9, 11) for i in range(12)]
+        assert verdict.tolist() == expect
+
+    def test_noncanonical_s_rejected(self):
+        priv = gen_priv_key(b"\x01" * 32)
+        msg = b"hello"
+        sig = priv.sign(msg)
+        s = int.from_bytes(sig[32:], "little")
+        bad = sig[:32] + (s + ed.L).to_bytes(32, "little")
+        verdict = ed.batch_verify(
+            [priv.pub_key.data] * 2, [msg] * 2, [sig, bad]
+        )
+        assert verdict.tolist() == [True, False]
+
+    def test_rfc8032_vectors(self):
+        # RFC 8032 §7.1 TEST 1-3
+        vectors = [
+            (
+                "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+                "",
+                "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+                "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+            ),
+            (
+                "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+                "72",
+                "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+                "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+            ),
+            (
+                "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+                "af82",
+                "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+                "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+            ),
+        ]
+        pubs = [bytes.fromhex(v[0]) for v in vectors]
+        msgs = [bytes.fromhex(v[1]) for v in vectors]
+        sigs = [bytes.fromhex(v[2]) for v in vectors]
+        assert ed.batch_verify(pubs, msgs, sigs).tolist() == [True, True, True]
+
+    def test_empty_batch(self):
+        assert ed.batch_verify([], [], []).shape == (0,)
