@@ -1,0 +1,111 @@
+"""Headline benchmark: ed25519 commit verification + Merkle throughput.
+
+Prints ONE JSON line. Primary metric is the BASELINE.md north star:
+ed25519 verifies/sec/chip on a 10k-validator commit batch (target 1M/s;
+vs_baseline is the ratio against that target since the reference
+publishes no numbers of its own — BASELINE.json `published: {}`).
+
+Runs on whatever backend JAX auto-selects (the real chip under axon).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
+    from tendermint_tpu.ops.ed25519_kernel import _bucket_size, prepare_batch, verify_kernel
+
+    sys.stderr.write(f"preparing {n_sigs} signatures...\n")
+    from tendermint_tpu.crypto.keys import gen_priv_key
+
+    # one key per distinct validator is realistic but slow to generate;
+    # cycle 256 keys over the batch (device cost is identical per lane).
+    privs = [gen_priv_key(bytes([i]) * 32) for i in range(min(256, n_sigs))]
+    msgs = [
+        b'{"chain_id":"bench-chain","vote":{"height":9,"round":0,"type":2,"index":%d}}'
+        % i
+        for i in range(n_sigs)
+    ]
+    sigs = [privs[i % len(privs)].sign(m) for i, m in enumerate(msgs)]
+    pubs = [privs[i % len(privs)].pub_key.data for i in range(n_sigs)]
+    pub, r, s, h, pre = prepare_batch(pubs, msgs, sigs)
+    size = _bucket_size(n_sigs)
+    if size != n_sigs:
+        pad = size - n_sigs
+        pub, r, s, h = (
+            np.concatenate([a, np.zeros((pad, 32), dtype=np.uint8)]) for a in (pub, r, s, h)
+        )
+
+    t0 = time.time()
+    out = np.asarray(verify_kernel(pub, r, s, h))
+    compile_s = time.time() - t0
+    assert out[:n_sigs].all(), "bench batch failed to verify"
+
+    best = float("inf")
+    for _ in range(warm_reps):
+        t0 = time.time()
+        np.asarray(verify_kernel(pub, r, s, h))
+        best = min(best, time.time() - t0)
+    return {
+        "n": n_sigs,
+        "padded": size,
+        "compile_s": round(compile_s, 2),
+        "warm_s": best,
+        # honest throughput: real signatures completed per second (the
+        # padded lanes do run, but a real commit only needs n_sigs)
+        "verifies_per_s": n_sigs / best,
+    }
+
+
+def _bench_merkle(n_leaves: int, leaf_bytes: int = 64) -> dict:
+    from tendermint_tpu.ops.merkle_kernel import merkle_root_device
+
+    items = [bytes([i % 256]) * leaf_bytes for i in range(n_leaves)]
+    t0 = time.time()
+    merkle_root_device(items)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    merkle_root_device(items)
+    warm = time.time() - t0
+    return {
+        "n_leaves": n_leaves,
+        "compile_s": round(compile_s, 2),
+        "warm_s": warm,
+        "leaves_per_s": n_leaves / warm,
+    }
+
+
+def main() -> None:
+    import jax
+
+    sys.stderr.write(f"devices: {jax.devices()}\n")
+    v10k = _bench_verify(10_000)
+    sys.stderr.write(f"verify@10k: {v10k}\n")
+    v1k = _bench_verify(1_000)
+    sys.stderr.write(f"verify@1k: {v1k}\n")
+    m = _bench_merkle(65_536)
+    sys.stderr.write(f"merkle@65k: {m}\n")
+
+    target = 1_000_000.0  # BASELINE.md: >=1M ed25519 verifies/s/chip
+    result = {
+        "metric": "ed25519_verifies_per_sec_per_chip",
+        "value": round(v10k["verifies_per_s"], 1),
+        "unit": "verifies/s",
+        "vs_baseline": round(v10k["verifies_per_s"] / target, 4),
+        "detail": {
+            "commit_10k_validators_ms": round(v10k["warm_s"] * 1e3, 2),
+            "commit_1k_validators_ms": round(v1k["warm_s"] * 1e3, 2),
+            "merkle_leaves_per_s": round(m["leaves_per_s"], 1),
+            "merkle_65k_ms": round(m["warm_s"] * 1e3, 2),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -318,12 +318,6 @@ def fe_to_bytes(a):
 # A point is a tuple of four fe's, each (..., 20).
 
 
-def pt_identity(batch_shape):
-    zero = jnp.zeros(batch_shape + (NLIMBS,), dtype=jnp.int32)
-    one = jnp.broadcast_to(jnp.asarray(_ONE_L), batch_shape + (NLIMBS,)).astype(jnp.int32)
-    return (zero, one, one, zero)
-
-
 def pt_add(p, q):
     """Unified addition (add-2008-hwcd-3, a=-1): 8M + some adds; branch-free."""
     x1, y1, z1, t1 = p
@@ -442,8 +436,13 @@ def double_scalar_mul(s_bits, p1, h_bits, p2):
     s_bits/h_bits: (..., 253) int32 bits LSB-first. Table: {O,P1,P2,P1+P2}.
     Runs as a lax.scan over 253 msb-first steps: double + table add.
     """
-    batch_shape = s_bits.shape[:-1]
-    t0 = pt_identity(batch_shape)
+    # Build the identity from the inputs (not fresh constants) so the scan
+    # carry is device-varying under shard_map — an invariant init vs a
+    # varying carry-out is a vma type error.
+    vzero = (s_bits[..., :1] * 0).astype(jnp.int32)  # (..., 1), all zeros
+    zero = vzero + jnp.zeros(NLIMBS, dtype=jnp.int32)
+    one = vzero + jnp.asarray(_ONE_L)
+    t0 = (zero, one, one, zero)
     t1 = p1
     t2 = p2
     t3 = pt_add(p1, p2)

@@ -1,0 +1,16 @@
+"""Multi-chip parallelism: mesh construction + sharded batch kernels.
+
+The reference scales by gossip across WAN peers (`p2p/`); the TPU-native
+data plane scales a *single node's* verification throughput across
+ICI-connected chips (SURVEY.md §5.8): shard the signature batch over a
+`jax.sharding.Mesh`, verify locally per chip, and reduce the voting-power
+tally with `psum`.
+"""
+
+from tendermint_tpu.parallel.mesh import (
+    batch_mesh,
+    sharded_verify_and_tally,
+    sharded_verify_kernel,
+)
+
+__all__ = ["batch_mesh", "sharded_verify_and_tally", "sharded_verify_kernel"]

@@ -1,0 +1,97 @@
+"""Device-mesh sharding for the verification data plane.
+
+One logical commit (N validator signatures + voting powers) is sharded
+along the batch axis across every chip in the mesh; each chip runs the
+ed25519 ladder on its shard and the >2/3 power tally is reduced with a
+single `psum` over ICI — the collective replaces the reference's
+sequential accumulate in `types/validator_set.go:236-261`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from tendermint_tpu.ops.ed25519_kernel import verify_kernel
+
+BATCH_AXIS = "batch"
+
+
+def batch_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices, batch-sharded."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def sharded_verify_kernel(mesh: Mesh):
+    """Compile a batch-sharded verify: (B,32)x4 uint8 -> (B,) bool.
+
+    B must be divisible by the mesh size; callers pad with zero rows
+    (which verify False and are masked out by the caller's precheck).
+    """
+    spec = P(BATCH_AXIS)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec),
+        out_specs=spec,
+    )
+    def _verify(pub, r, s, h):
+        return verify_kernel(pub, r, s, h)
+
+    return _verify
+
+
+def sharded_verify_and_tally(mesh: Mesh):
+    """Compile the full commit-verification step over the mesh.
+
+    Inputs: (B,32)x4 uint8 sig batch + (B,) int32 voting powers.
+    Returns ((B,) bool verdicts, () int32 verified-power total) — the
+    total is psum-reduced across chips so every shard holds the global
+    tally (the 2/3-quorum decision needs no host gather).
+    """
+    spec = P(BATCH_AXIS)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(spec, P()),
+    )
+    def _step(pub, r, s, h, power):
+        ok = verify_kernel(pub, r, s, h)
+        # int32 tally: JAX x64 is globally disabled; callers with >2^31
+        # total power must scale powers down before shipping them.
+        local = jnp.sum(jnp.where(ok, power, 0).astype(jnp.int32))
+        total = jax.lax.psum(local, BATCH_AXIS)
+        return ok, total
+
+    return _step
+
+
+def pad_to_multiple(arrays, powers, multiple: int):
+    """Pad (B,32) byte arrays + (B,) powers up to a multiple of `multiple`.
+
+    Padding rows are zeros: they decompress to invalid points, verify
+    False, and carry zero power — so the psum tally is unaffected.
+    """
+    b = arrays[0].shape[0]
+    size = ((b + multiple - 1) // multiple) * multiple
+    if size == b:
+        return arrays, powers, b
+    pad = size - b
+    arrays = [np.concatenate([a, np.zeros((pad, 32), dtype=np.uint8)]) for a in arrays]
+    powers = np.concatenate([powers, np.zeros(pad, dtype=powers.dtype)])
+    return arrays, powers, b

@@ -1,0 +1,22 @@
+"""Batching service layer: the seam between consensus logic and TPU kernels.
+
+`BatchVerifier` / `TreeHasher` sit exactly where the reference calls
+`crypto.PubKey.VerifyBytes` and `tmlibs/merkle.SimpleHash*` (SURVEY.md §2b),
+replacing one-at-a-time calls with an accumulate→flush batching contract.
+"""
+
+from tendermint_tpu.services.hasher import TreeHasher
+from tendermint_tpu.services.verifier import (
+    BatchVerifier,
+    DeviceBatchVerifier,
+    HostBatchVerifier,
+    default_verifier,
+)
+
+__all__ = [
+    "BatchVerifier",
+    "DeviceBatchVerifier",
+    "HostBatchVerifier",
+    "TreeHasher",
+    "default_verifier",
+]

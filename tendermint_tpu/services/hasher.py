@@ -1,0 +1,64 @@
+"""TreeHasher: batched Merkle tree construction service.
+
+Fills the `tmlibs/merkle.SimpleHash*` slot (reference call sites:
+`types/block.go:177`, `types/tx.go:33-46`, `types/part_set.go:95-122`).
+The device backend hashes all leaves as one batched SHA-256 kernel call
+and reduces the tree in log2(N) fused levels entirely on device; the
+host backend is the bit-identical sequential reference.
+
+The reference's tree uses RIPEMD-160 (`docs/specification/merkle.rst`);
+this framework's target variant is SHA-256 (BASELINE.md north star).
+Device trees support sha256; ripemd160 trees fall back to host.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tendermint_tpu.merkle import simple as host_merkle
+
+
+class TreeHasher:
+    """Merkle root/proof builder with host and device backends."""
+
+    def __init__(self, backend: str = "device", algo: str = "sha256") -> None:
+        if backend not in ("device", "host"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.algo = algo
+        # device tree reduction is sha256-only; ripemd160 stays on host
+        self.backend = backend if algo == "sha256" else "host"
+
+    def root_from_items(self, items: list[bytes]) -> bytes:
+        """SimpleMerkle root over raw byte leaves (leaf-prefixed hashes)."""
+        if self.backend == "device" and len(items) > 1:
+            from tendermint_tpu.ops.merkle_kernel import merkle_root_device
+
+            return merkle_root_device(items)
+        return host_merkle.simple_hash_from_byte_slices(items, self.algo)
+
+    def root_from_hashes(self, hashes: list[bytes]) -> bytes:
+        """Root over already-hashed leaves (PartSet/Commit aggregation)."""
+        if self.backend == "device" and len(hashes) > 1:
+            from tendermint_tpu.ops.merkle_kernel import merkle_root_from_leaf_words
+            from tendermint_tpu.ops.padding import digests_to_bytes_be
+
+            words = np.stack(
+                [np.frombuffer(h, dtype=">u4").astype(np.uint32) for h in hashes]
+            )
+            root = merkle_root_from_leaf_words(words)
+            return digests_to_bytes_be(np.asarray(root)[None, :])[0]
+        return host_merkle.simple_hash_from_hashes(hashes, self.algo)
+
+    def proofs(self, items: list[bytes]):
+        """Merkle proofs stay on host: O(N log N) pointer work, tiny data."""
+        return host_merkle.simple_proofs_from_byte_slices(items, self.algo)
+
+
+_DEFAULT: TreeHasher | None = None
+
+
+def default_hasher() -> TreeHasher:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = TreeHasher()
+    return _DEFAULT

@@ -1,0 +1,112 @@
+"""BatchVerifier: accumulate→flush ed25519 verification service.
+
+The reference verifies one signature at a time inside its hot loops
+(`types/vote_set.go:177`, `types/validator_set.go:253`). Here every
+consumer — VoteSet, ValidatorSet.verify_commit, fast-sync, light client —
+talks to a `BatchVerifier`:
+
+* `verify_batch(triples)` — synchronous batch verdicts (the call the
+  types layer already targets);
+* `add(pk, msg, sig)` / `flush()` — optimistic accumulation across
+  call sites, flushed as one device batch (SURVEY.md §7 hard part 3:
+  per-item verdict masks preserve per-vote error attribution).
+
+Backends: `HostBatchVerifier` (sequential host library — the CPU
+baseline and the no-TPU test fake) and `DeviceBatchVerifier` (the
+batched curve kernel in `ops.ed25519_kernel`, padded to power-of-two
+buckets so recompiles stay bounded).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+Triple = tuple[bytes, bytes, bytes]  # (pubkey32, message, signature64)
+
+
+class BatchVerifier:
+    """Interface + shared accumulate/flush bookkeeping."""
+
+    def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
+        raise NotImplementedError
+
+    def __init__(self) -> None:
+        self._pending: list[Triple] = []
+
+    def add(self, pubkey: bytes, msg: bytes, sig: bytes) -> int:
+        """Queue a triple; returns its index into the next flush()'s mask."""
+        self._pending.append((pubkey, msg, sig))
+        return len(self._pending) - 1
+
+    def pending(self) -> int:
+        return len(self._pending)
+
+    def flush(self) -> np.ndarray:
+        """Verify everything queued since the last flush; per-item verdicts."""
+        triples, self._pending = self._pending, []
+        if not triples:
+            return np.zeros(0, dtype=bool)
+        return self.verify_batch(triples)
+
+    def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+        return bool(self.verify_batch([(pubkey, msg, sig)])[0])
+
+
+class HostBatchVerifier(BatchVerifier):
+    """Sequential host-library backend (CPU baseline / TPU-free tests)."""
+
+    def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
+        from tendermint_tpu.crypto.keys import PUBKEY_LEN, PubKey
+
+        out = np.zeros(len(triples), dtype=bool)
+        for i, (pk, msg, sig) in enumerate(triples):
+            if len(pk) != PUBKEY_LEN:
+                continue
+            out[i] = PubKey(pk).verify(msg, sig)
+        return out
+
+
+class DeviceBatchVerifier(BatchVerifier):
+    """TPU-batched backend over `ops.ed25519_kernel.batch_verify`.
+
+    Batches are padded to power-of-two buckets (min 8) inside
+    batch_verify; compiled executables persist in the jit cache per
+    bucket size.
+    """
+
+    def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
+        from tendermint_tpu.ops.ed25519_kernel import batch_verify
+
+        if not triples:
+            return np.zeros(0, dtype=bool)
+        pubs, msgs, sigs = zip(*triples)
+        return batch_verify(list(pubs), list(msgs), list(sigs))
+
+
+_DEFAULT: BatchVerifier | None = None
+
+
+def default_verifier() -> BatchVerifier:
+    """Process-wide verifier: device-backed iff an accelerator is up.
+
+    On CPU-only hosts the emulated curve kernel is far slower than the
+    host crypto library, so fall back to HostBatchVerifier there.
+    Consensus paths that don't thread an explicit verifier use this
+    (mirrors the reference's package-global crypto functions).
+    """
+    global _DEFAULT
+    if _DEFAULT is None:
+        import jax
+
+        if jax.default_backend() == "cpu":
+            _DEFAULT = HostBatchVerifier()
+        else:
+            _DEFAULT = DeviceBatchVerifier()
+    return _DEFAULT
+
+
+def set_default_verifier(v: BatchVerifier) -> None:
+    global _DEFAULT
+    _DEFAULT = v

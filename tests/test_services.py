@@ -1,0 +1,106 @@
+"""BatchVerifier / TreeHasher service layer + mesh-sharded verification."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto.keys import gen_priv_key
+from tendermint_tpu.merkle.simple import (
+    simple_hash_from_byte_slices,
+    simple_hash_from_hashes,
+)
+from tendermint_tpu.services import (
+    DeviceBatchVerifier,
+    HostBatchVerifier,
+    TreeHasher,
+)
+
+
+def _triples(n, corrupt=()):
+    privs = [gen_priv_key(bytes([i + 1]) * 32) for i in range(n)]
+    msgs = [b"msg-%d" % i for i in range(n)]
+    out = []
+    for i, (p, m) in enumerate(zip(privs, msgs)):
+        sig = p.sign(m)
+        if i in corrupt:
+            sig = sig[:8] + bytes([sig[8] ^ 1]) + sig[9:]
+        out.append((p.pub_key.data, m, sig))
+    return out
+
+
+class TestBatchVerifier:
+    @pytest.mark.parametrize("cls", [HostBatchVerifier, DeviceBatchVerifier])
+    def test_verify_batch_localizes_failures(self, cls):
+        v = cls()
+        verdict = v.verify_batch(_triples(6, corrupt={1, 4}))
+        assert verdict.tolist() == [True, False, True, True, False, True]
+
+    def test_accumulate_flush(self):
+        v = DeviceBatchVerifier()
+        triples = _triples(5, corrupt={2})
+        idxs = [v.add(*t) for t in triples]
+        assert idxs == [0, 1, 2, 3, 4]
+        assert v.pending() == 5
+        verdict = v.flush()
+        assert verdict.tolist() == [True, True, False, True, True]
+        assert v.pending() == 0
+        assert v.flush().shape == (0,)
+
+    def test_verify_one(self):
+        v = HostBatchVerifier()
+        (pk, m, sig) = _triples(1)[0]
+        assert v.verify_one(pk, m, sig)
+        assert not v.verify_one(pk, m + b"!", sig)
+
+    def test_host_device_agree(self):
+        triples = _triples(9, corrupt={0, 8})
+        host = HostBatchVerifier().verify_batch(triples)
+        dev = DeviceBatchVerifier().verify_batch(triples)
+        assert (host == dev).all()
+
+
+class TestTreeHasher:
+    def test_device_root_matches_host(self):
+        items = [b"item-%d" % i for i in range(13)]
+        assert TreeHasher("device").root_from_items(items) == simple_hash_from_byte_slices(items)
+
+    def test_root_from_hashes(self):
+        from tendermint_tpu.merkle.simple import leaf_hash
+
+        hashes = [leaf_hash(b"x%d" % i) for i in range(7)]
+        assert TreeHasher("device").root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
+        assert TreeHasher("host").root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
+
+    def test_ripemd_falls_back_to_host(self):
+        th = TreeHasher("device", algo="ripemd160")
+        assert th.backend == "host"
+        items = [b"a", b"b", b"c"]
+        assert th.root_from_items(items) == simple_hash_from_byte_slices(items, "ripemd160")
+
+    def test_edge_counts(self):
+        th = TreeHasher("device")
+        assert th.root_from_items([]) == b""
+        assert th.root_from_items([b"one"]) == simple_hash_from_byte_slices([b"one"])
+
+
+class TestShardedVerify:
+    def test_verify_and_tally_on_8_device_mesh(self):
+        import jax
+
+        from tendermint_tpu.ops.ed25519_kernel import prepare_batch
+        from tendermint_tpu.parallel.mesh import (
+            batch_mesh,
+            pad_to_multiple,
+            sharded_verify_and_tally,
+        )
+
+        assert len(jax.devices()) == 8, "conftest must force the 8-device cpu mesh"
+        triples = _triples(10, corrupt={3})
+        pubs, msgs, sigs = (list(x) for x in zip(*triples))
+        pub, r, s, h, pre = prepare_batch(pubs, msgs, sigs)
+        powers = np.full(10, 5, dtype=np.int32)
+        arrs, powers, valid = pad_to_multiple([pub, r, s, h], powers, 8)
+        step = sharded_verify_and_tally(batch_mesh())
+        ok, total = step(*arrs, powers)
+        ok = np.asarray(ok)[:valid]
+        assert ok.tolist() == [True] * 3 + [False] + [True] * 6
+        assert int(total) == 45  # 9 valid * power 5
