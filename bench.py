@@ -18,7 +18,8 @@ import numpy as np
 
 
 def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
-    from tendermint_tpu.ops.ed25519_kernel import _bucket_size, prepare_batch, verify_kernel
+    from tendermint_tpu.ops.ed25519_kernel import bucket_size, prepare_batch, verify_kernel
+    from tendermint_tpu.parallel.mesh import pad_to_multiple
 
     sys.stderr.write(f"preparing {n_sigs} signatures...\n")
     from tendermint_tpu.crypto.keys import gen_priv_key
@@ -34,12 +35,10 @@ def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
     sigs = [privs[i % len(privs)].sign(m) for i, m in enumerate(msgs)]
     pubs = [privs[i % len(privs)].pub_key.data for i in range(n_sigs)]
     pub, r, s, h, pre = prepare_batch(pubs, msgs, sigs)
-    size = _bucket_size(n_sigs)
-    if size != n_sigs:
-        pad = size - n_sigs
-        pub, r, s, h = (
-            np.concatenate([a, np.zeros((pad, 32), dtype=np.uint8)]) for a in (pub, r, s, h)
-        )
+    size = bucket_size(n_sigs)
+    (pub, r, s, h), _, _ = pad_to_multiple(
+        [pub, r, s, h], np.zeros(n_sigs, dtype=np.int32), size
+    )
 
     t0 = time.time()
     out = np.asarray(verify_kernel(pub, r, s, h))

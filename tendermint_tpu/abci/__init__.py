@@ -1,0 +1,40 @@
+"""ABCI: the application/consensus process seam.
+
+The reference talks to its application over the ABCI socket/gRPC
+protocol through three logical connections (consensus/mempool/query,
+`proxy/app_conn.go:11-41`). Here the same seam exists with an in-process
+client (reference's local client) — a future gRPC transport slots in
+behind `ClientCreator` without touching consumers.
+"""
+
+from tendermint_tpu.abci.types import (
+    CodeType,
+    Result,
+    ResultInfo,
+    ResultQuery,
+    Validator as ABCIValidator,
+    OK,
+)
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.abci.client import (
+    AppConnConsensus,
+    AppConnMempool,
+    AppConnQuery,
+    AppConns,
+    local_client_creator,
+)
+
+__all__ = [
+    "Application",
+    "AppConnConsensus",
+    "AppConnMempool",
+    "AppConnQuery",
+    "AppConns",
+    "ABCIValidator",
+    "CodeType",
+    "OK",
+    "Result",
+    "ResultInfo",
+    "ResultQuery",
+    "local_client_creator",
+]

@@ -1,0 +1,46 @@
+"""ABCI application base class (role of abci types.Application)."""
+
+from __future__ import annotations
+
+from tendermint_tpu.abci.types import Result, ResultInfo, ResultQuery, Validator
+
+
+class Application:
+    """Override what you need; defaults are no-op OK responses."""
+
+    # -- query connection ----------------------------------------------------
+
+    def echo(self, msg: str) -> str:
+        return msg
+
+    def info(self) -> ResultInfo:
+        return ResultInfo()
+
+    def set_option(self, key: str, value: str) -> str:
+        return ""
+
+    def query(self, path: str, data: bytes, height: int = 0, prove: bool = False) -> ResultQuery:
+        return ResultQuery()
+
+    # -- mempool connection --------------------------------------------------
+
+    def check_tx(self, tx: bytes) -> Result:
+        return Result()
+
+    # -- consensus connection ------------------------------------------------
+
+    def init_chain(self, validators: list[Validator]) -> None:
+        pass
+
+    def begin_block(self, block_hash: bytes, header) -> None:
+        pass
+
+    def deliver_tx(self, tx: bytes) -> Result:
+        return Result()
+
+    def end_block(self, height: int) -> list[Validator]:
+        return []
+
+    def commit(self) -> Result:
+        """Returns the app hash for the next block header."""
+        return Result()

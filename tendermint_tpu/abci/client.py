@@ -1,0 +1,113 @@
+"""ABCI client + the three-connection proxy (reference `proxy/`).
+
+`AppConns` owns consensus/mempool/query connections to one application
+(`proxy/multi_app_conn.go:12-18`). The local client serializes access
+with one mutex per client — matching the reference's in-proc
+`localClient` — while separate connections keep mempool CheckTx from
+blocking consensus DeliverTx and vice versa.
+
+Async semantics: the reference pipelines `DeliverTxAsync` over a socket
+and collects callbacks (`state/execution.go:50-101`). In-process, calls
+are synchronous but the `*_async` names keep the pipelining seam: a
+remote transport can reintroduce true overlap without changing callers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tendermint_tpu.abci.application import Application
+from tendermint_tpu.abci.types import Result, ResultInfo, ResultQuery, Validator
+
+
+class _LocalClient:
+    """Mutex-wrapped in-process app access (reference localClient)."""
+
+    def __init__(self, app: Application, lock: threading.Lock) -> None:
+        self._app = app
+        self._lock = lock
+        self.error: Exception | None = None
+
+    # every call holds the shared app mutex
+
+
+class AppConnQuery(_LocalClient):
+    def echo_sync(self, msg: str) -> str:
+        with self._lock:
+            return self._app.echo(msg)
+
+    def info_sync(self) -> ResultInfo:
+        with self._lock:
+            return self._app.info()
+
+    def query_sync(self, path: str, data: bytes, height: int = 0, prove: bool = False) -> ResultQuery:
+        with self._lock:
+            return self._app.query(path, data, height, prove)
+
+
+class AppConnMempool(_LocalClient):
+    def check_tx_async(self, tx: bytes, cb: Callable[[Result], None] | None = None) -> Result:
+        with self._lock:
+            res = self._app.check_tx(tx)
+        if cb is not None:
+            cb(res)
+        return res
+
+    def flush_sync(self) -> None:
+        pass
+
+    def flush_async(self) -> None:
+        pass
+
+
+class AppConnConsensus(_LocalClient):
+    def init_chain_sync(self, validators: list[Validator]) -> None:
+        with self._lock:
+            self._app.init_chain(validators)
+
+    def begin_block_sync(self, block_hash: bytes, header) -> None:
+        with self._lock:
+            self._app.begin_block(block_hash, header)
+
+    def deliver_tx_async(self, tx: bytes, cb: Callable[[Result], None] | None = None) -> Result:
+        with self._lock:
+            res = self._app.deliver_tx(tx)
+        if cb is not None:
+            cb(res)
+        return res
+
+    def end_block_sync(self, height: int) -> list[Validator]:
+        with self._lock:
+            return self._app.end_block(height)
+
+    def commit_sync(self) -> Result:
+        with self._lock:
+            return self._app.commit()
+
+
+class AppConns:
+    """The three typed connections to one application."""
+
+    def __init__(self, consensus: AppConnConsensus, mempool: AppConnMempool, query: AppConnQuery):
+        self.consensus = consensus
+        self.mempool = mempool
+        self.query = query
+
+
+ClientCreator = Callable[[], AppConns]
+
+
+def local_client_creator(app: Application) -> ClientCreator:
+    """In-proc creator: three connections sharing one app mutex
+    (reference `proxy/client.go:24-44` NewLocalClientCreator)."""
+
+    def create() -> AppConns:
+        lock = threading.Lock()
+        return AppConns(
+            AppConnConsensus(app, lock),
+            AppConnMempool(app, lock),
+            AppConnQuery(app, lock),
+        )
+
+    return create

@@ -529,7 +529,7 @@ def prepare_batch(pubkeys, msgs, sigs):
     return pub, r, s, h, precheck
 
 
-def _bucket_size(n: int) -> int:
+def bucket_size(n: int) -> int:
     """Pad batch sizes to power-of-two buckets (min 8) to bound recompiles."""
     size = 8
     while size < n:
@@ -547,7 +547,7 @@ def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=bool)
     pub, r, s, h, precheck = prepare_batch(pubkeys, msgs, sigs)
-    size = _bucket_size(n)
+    size = bucket_size(n)
     if size != n:
         pad = size - n
 

@@ -36,7 +36,8 @@ def sharded_verify_kernel(mesh: Mesh):
     """Compile a batch-sharded verify: (B,32)x4 uint8 -> (B,) bool.
 
     B must be divisible by the mesh size; callers pad with zero rows
-    (which verify False and are masked out by the caller's precheck).
+    and slice the output back to the real count (zero rows verify
+    False — see pad_to_multiple).
     """
     spec = P(BATCH_AXIS)
 
@@ -84,8 +85,12 @@ def sharded_verify_and_tally(mesh: Mesh):
 def pad_to_multiple(arrays, powers, multiple: int):
     """Pad (B,32) byte arrays + (B,) powers up to a multiple of `multiple`.
 
-    Padding rows are zeros: they decompress to invalid points, verify
-    False, and carry zero power — so the psum tally is unaffected.
+    Padding rows are zeros. A zero row does decode (y=0 is a valid
+    order-4 point) but still verifies False because S=h=0 makes the
+    ladder produce the identity, which never equals the decoded R point
+    (0, 1) != (±sqrt(-1), 0); powers are zero too, so the psum tally is
+    unaffected either way. Don't replace zero padding with copied rows —
+    those WOULD verify True and corrupt the tally if given power.
     """
     b = arrays[0].shape[0]
     size = ((b + multiple - 1) // multiple) * multiple

@@ -1,0 +1,20 @@
+"""Chain state + block execution (reference `state/`)."""
+
+from tendermint_tpu.state.state import ABCIResponses, State, load_state, make_genesis_state
+from tendermint_tpu.state.execution import (
+    BlockExecutionError,
+    apply_block,
+    exec_commit_block,
+    validate_block,
+)
+
+__all__ = [
+    "ABCIResponses",
+    "BlockExecutionError",
+    "State",
+    "apply_block",
+    "exec_commit_block",
+    "load_state",
+    "make_genesis_state",
+    "validate_block",
+]

@@ -1,0 +1,143 @@
+"""Block validation + execution pipeline (reference `state/execution.go`).
+
+`apply_block` is the commit-side hot path (§3.2 tail): validate the block
+(including the batched `LastValidators.verify_commit` — the TPU hot
+loop), stream txs through the app, save ABCIResponses *before* the app
+commit (crash recovery), rotate validator sets, commit the app under the
+mempool lock, and persist. Fail points bracket every persistence step
+exactly like the reference (`state/execution.go:224-243`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from tendermint_tpu.abci.client import AppConnConsensus
+from tendermint_tpu.abci.types import Result
+from tendermint_tpu.state.state import ABCIResponses, State
+from tendermint_tpu.types.block import Block
+from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.services import MempoolI, NopMempool
+from tendermint_tpu.utils.fail import fail_point
+
+
+class BlockExecutionError(Exception):
+    pass
+
+
+def validate_block(state: State, block: Block, verifier=None) -> None:
+    """Reference `validateBlock` (`state/execution.go:181-206`): header
+    fields against state, then LastCommit against LastValidators — the
+    latter as one signature batch."""
+    block.validate_basic()
+    if block.header.chain_id != state.chain_id:
+        raise ValidationError(
+            f"wrong chain_id: got {block.header.chain_id}, want {state.chain_id}"
+        )
+    if block.header.height != state.last_block_height + 1:
+        raise ValidationError(
+            f"wrong height: got {block.header.height}, want {state.last_block_height + 1}"
+        )
+    if block.header.last_block_id != state.last_block_id:
+        raise ValidationError(
+            f"wrong last_block_id: got {block.header.last_block_id}, want {state.last_block_id}"
+        )
+    if block.header.app_hash != state.app_hash:
+        raise ValidationError(
+            f"wrong app_hash: got {block.header.app_hash.hex()}, want {state.app_hash.hex()}"
+        )
+    if block.header.validators_hash != state.validators.hash():
+        raise ValidationError("wrong validators_hash")
+    if block.header.height == 1:
+        if len(block.last_commit.precommits) != 0:
+            raise ValidationError("block at height 1 can't have LastCommit signatures")
+    else:
+        if len(block.last_commit.precommits) != state.last_validators.size():
+            raise ValidationError(
+                f"wrong LastCommit size: got {len(block.last_commit.precommits)}, "
+                f"want {state.last_validators.size()}"
+            )
+        state.last_validators.verify_commit(
+            state.chain_id,
+            state.last_block_id,
+            block.header.height - 1,
+            block.last_commit,
+            verifier=verifier,
+        )
+
+
+def exec_block_on_proxy_app(
+    app_conn: AppConnConsensus,
+    block: Block,
+    on_tx_result: Callable[[int, bytes, Result], None] | None = None,
+) -> ABCIResponses:
+    """BeginBlock, DeliverTx per tx, EndBlock (reference
+    `execBlockOnProxyApp state/execution.go:43-118`). Tx results stream
+    to `on_tx_result` (the event bus slot)."""
+    app_conn.begin_block_sync(block.hash(), block.header)
+    responses = ABCIResponses(height=block.header.height)
+    for i, tx in enumerate(block.data.txs):
+        res = app_conn.deliver_tx_async(bytes(tx))
+        responses.deliver_tx.append(res)
+        if on_tx_result is not None:
+            on_tx_result(i, bytes(tx), res)
+    responses.end_block_changes = app_conn.end_block_sync(block.header.height)
+    return responses
+
+
+def apply_block(
+    state: State,
+    block: Block,
+    part_set_header: PartSetHeader,
+    app_conn: AppConnConsensus,
+    mempool: MempoolI | None = None,
+    verifier=None,
+    tx_indexer=None,
+    on_tx_result: Callable[[int, bytes, Result], None] | None = None,
+) -> State:
+    """Validate, execute, persist; returns the advanced state
+    (reference `ApplyBlock state/execution.go:216-249`). Mutates and
+    returns `state`; callers pass a copy when they need the original."""
+    validate_block(state, block, verifier=verifier)
+
+    fail_point()  # before any execution effects
+    abci_responses = exec_block_on_proxy_app(app_conn, block, on_tx_result)
+
+    fail_point()  # after app execution, before saving responses
+    state.save_abci_responses(abci_responses)
+
+    fail_point()  # responses saved, before state advance + app commit
+    if tx_indexer is not None:
+        tx_indexer.add_batch(block, abci_responses)
+    state.set_block_and_validators(block.header, part_set_header, abci_responses)
+
+    # app Commit under the mempool lock, then recheck leftover txs
+    # (reference CommitStateUpdateMempool `state/execution.go:254-277`)
+    mempool = mempool if mempool is not None else NopMempool()
+    mempool.lock()
+    try:
+        res = app_conn.commit_sync()
+        if not res.is_ok:
+            raise BlockExecutionError(f"app commit failed: {res.log}")
+        state.app_hash = res.data
+        mempool.update(block.header.height, block.data.txs)
+    finally:
+        mempool.unlock()
+
+    fail_point()  # app committed, before state save
+    state.save()
+    return state
+
+
+def exec_commit_block(
+    app_conn: AppConnConsensus, block: Block, verifier=None
+) -> bytes:
+    """Execute + commit a block against the app WITHOUT touching state —
+    used by the handshake replay (reference `ExecCommitBlock
+    state/execution.go:297-314`). Returns the new app hash."""
+    exec_block_on_proxy_app(app_conn, block)
+    res = app_conn.commit_sync()
+    if not res.is_ok:
+        raise BlockExecutionError(f"app commit failed: {res.log}")
+    return res.data

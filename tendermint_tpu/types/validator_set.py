@@ -138,9 +138,11 @@ class ValidatorSet:
                 self.validators.pop(idx)
             elif existing is None:
                 self.validators.append(replace(c, accum=0))
+                # keep sorted so get_by_address (bisect) stays correct for
+                # any further change in this same batch
+                self.validators.sort(key=lambda v: v.address)
             else:
                 self.validators[idx] = replace(existing, voting_power=c.voting_power)
-        self.validators.sort(key=lambda v: v.address)
         self._total = sum(v.voting_power for v in self.validators)
         self._proposer = None
 
@@ -264,10 +266,13 @@ class ValidatorSet:
 
 
 def _verify_triples(triples: list[tuple[bytes, bytes, bytes]], verifier) -> list[bool]:
-    """Verify (pubkey,msg,sig) triples: one device batch if a BatchVerifier is
-    given, else the host loop (the reference's sequential path)."""
+    """Verify (pubkey,msg,sig) triples as one batch through the given
+    BatchVerifier, defaulting to the process-wide verifier (device-backed
+    when an accelerator is present)."""
     if not triples:
         return []
-    if verifier is not None:
-        return list(verifier.verify_batch(triples))
-    return [PubKey(pk).verify(msg, sig) for pk, msg, sig in triples]
+    if verifier is None:
+        from tendermint_tpu.services.verifier import default_verifier
+
+        verifier = default_verifier()
+    return list(verifier.verify_batch(triples))

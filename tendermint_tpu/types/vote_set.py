@@ -109,10 +109,11 @@ class VoteSet:
 
     def _verify_signature(self, vote: Vote, pub_key: PubKey, verifier) -> None:
         msg = vote.sign_bytes(self.chain_id)
-        if verifier is not None:
-            ok = bool(verifier.verify_batch([(pub_key.data, msg, vote.signature)])[0])
-        else:
-            ok = pub_key.verify(msg, vote.signature)
+        if verifier is None:
+            from tendermint_tpu.services.verifier import default_verifier
+
+            verifier = default_verifier()
+        ok = bool(verifier.verify_batch([(pub_key.data, msg, vote.signature)])[0])
         if not ok:
             raise ErrVoteInvalidSignature(f"invalid signature on {vote}")
 

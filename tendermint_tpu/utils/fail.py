@@ -1,0 +1,33 @@
+"""Crash-point injection (role of ebuchman/fail-test in the reference).
+
+`fail_point()` calls are numbered in program order per process; when the
+`FAIL_TEST_INDEX` env var equals the current index the process exits
+immediately with status 1 — exactly the reference's semantics
+(`consensus/state.go:1172-1233`, `state/execution.go:224-243`,
+`test/persist/test_failure_indices.sh:39-41`). Used by the
+kill-at-every-persistence-step recovery test matrix.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_counter = 0
+
+
+def fail_point() -> None:
+    global _counter
+    target = os.environ.get("FAIL_TEST_INDEX")
+    if target is None:
+        return
+    if _counter == int(target):
+        sys.stderr.write(f"FAIL_TEST_INDEX={target}: exiting at fail point\n")
+        sys.stderr.flush()
+        os._exit(1)
+    _counter += 1
+
+
+def reset_for_testing() -> None:
+    global _counter
+    _counter = 0
