@@ -1,0 +1,5 @@
+"""Block storage + fast-sync (reference `blockchain/`)."""
+
+from tendermint_tpu.blockchain.store import BlockMeta, BlockStore
+
+__all__ = ["BlockMeta", "BlockStore"]

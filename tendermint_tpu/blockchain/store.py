@@ -1,0 +1,133 @@
+"""Persistent block storage (reference `blockchain/store.go:31-230`).
+
+Per height: block meta, the block's parts (individually, so gossip can
+serve single parts), the canonical commit of the *previous* block, and
+the locally-seen commit (+2/3 precommits this node saw — may differ from
+the canonical one and is needed to reconstruct the consensus LastCommit
+on restart, `consensus/state.go:392-411`). A JSON height watermark marks
+the contiguous store head.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+from tendermint_tpu.codec import Reader, Writer
+from tendermint_tpu.db.kv import DB
+from tendermint_tpu.types.block import Block, Commit, Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader
+
+
+@dataclass
+class BlockMeta:
+    """BlockID + header, servable without the full block
+    (reference `types.BlockMeta`)."""
+
+    block_id: BlockID
+    header: Header
+
+    def encode(self) -> bytes:
+        return Writer().raw(self.block_id.encode()).bytes(self.header.encode()).build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockMeta":
+        r = Reader(data)
+        block_id = BlockID.decode_from(r)
+        header = Header.decode_from(Reader(r.bytes()))
+        return cls(block_id=block_id, header=header)
+
+
+class BlockStore:
+    def __init__(self, db: DB) -> None:
+        self._db = db
+        self._height = 0
+        raw = db.get(b"blockStore")
+        if raw is not None:
+            self._height = json.loads(raw.decode())["height"]
+
+    @property
+    def height(self) -> int:
+        """Height of the newest stored block (0 if empty)."""
+        return self._height
+
+    # -- keys ----------------------------------------------------------------
+
+    @staticmethod
+    def _meta_key(height: int) -> bytes:
+        return b"H:%d" % height
+
+    @staticmethod
+    def _part_key(height: int, index: int) -> bytes:
+        return b"P:%d:%d" % (height, index)
+
+    @staticmethod
+    def _commit_key(height: int) -> bytes:
+        return b"C:%d" % height
+
+    @staticmethod
+    def _seen_commit_key(height: int) -> bytes:
+        return b"SC:%d" % height
+
+    # -- save ----------------------------------------------------------------
+
+    def save_block(self, block: Block, part_set: PartSet, seen_commit: Commit) -> None:
+        """Reference `SaveBlock :148`: must be called with height ==
+        store height + 1 (contiguous chain)."""
+        height = block.header.height
+        if height != self._height + 1:
+            raise ValidationError(
+                f"BlockStore can only save contiguous blocks: have {self._height}, got {height}"
+            )
+        if not part_set.is_complete():
+            raise ValidationError("BlockStore can only save complete part sets")
+        meta = BlockMeta(
+            block_id=BlockID(block.hash(), part_set.header), header=block.header
+        )
+        self._db.set(self._meta_key(height), meta.encode())
+        for i in range(part_set.total):
+            part = part_set.get_part(i)
+            self._db.set(self._part_key(height, i), part.encode())
+        # commit of block H-1 (carried inside block H)
+        self._db.set(self._commit_key(height - 1), block.last_commit.encode())
+        # commit that made THIS block (what we saw locally)
+        self._db.set(self._seen_commit_key(height), seen_commit.encode())
+        self._height = height
+        self._db.set_sync(b"blockStore", json.dumps({"height": height}).encode())
+
+    # -- load ----------------------------------------------------------------
+
+    def load_block_meta(self, height: int) -> BlockMeta | None:
+        raw = self._db.get(self._meta_key(height))
+        return BlockMeta.decode(raw) if raw is not None else None
+
+    def load_block_part(self, height: int, index: int) -> Part | None:
+        raw = self._db.get(self._part_key(height, index))
+        return Part.decode(raw) if raw is not None else None
+
+    def load_block(self, height: int) -> Block | None:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        buf = b""
+        for i in range(meta.block_id.parts_header.total):
+            part = self.load_block_part(height, i)
+            if part is None:
+                return None
+            buf += part.bytes_
+        return Block.decode(buf)
+
+    def load_block_commit(self, height: int) -> Commit | None:
+        """Canonical commit for block at `height` (from block height+1)."""
+        raw = self._db.get(self._commit_key(height))
+        if raw is None:
+            return None
+        return Commit.decode_from(Reader(raw))
+
+    def load_seen_commit(self, height: int) -> Commit | None:
+        raw = self._db.get(self._seen_commit_key(height))
+        if raw is None:
+            return None
+        return Commit.decode_from(Reader(raw))

@@ -1,0 +1,24 @@
+"""Consensus core (reference `consensus/`): the BFT state machine, its
+write-ahead log, timeout scheduling, and crash recovery."""
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.round_state import (
+    HeightVoteSet,
+    RoundState,
+    RoundStepType,
+)
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.ticker import MockTicker, TimeoutTicker
+from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+__all__ = [
+    "WAL",
+    "ConsensusConfig",
+    "ConsensusState",
+    "EndHeightMessage",
+    "HeightVoteSet",
+    "MockTicker",
+    "RoundState",
+    "RoundStepType",
+    "TimeoutTicker",
+]

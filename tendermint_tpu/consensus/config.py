@@ -1,0 +1,49 @@
+"""Consensus timing/behavior config (reference `config/config.go:295-400`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ConsensusConfig:
+    # timeouts in milliseconds; *_delta grows per round
+    timeout_propose: int = 3000
+    timeout_propose_delta: int = 500
+    timeout_prevote: int = 1000
+    timeout_prevote_delta: int = 500
+    timeout_precommit: int = 1000
+    timeout_precommit_delta: int = 500
+    timeout_commit: int = 1000
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: int = 0  # seconds
+    max_block_size_txs: int = 10_000
+    wal_light: bool = False
+
+    def propose_timeout(self, round_: int) -> float:
+        return (self.timeout_propose + self.timeout_propose_delta * round_) / 1000.0
+
+    def prevote_timeout(self, round_: int) -> float:
+        return (self.timeout_prevote + self.timeout_prevote_delta * round_) / 1000.0
+
+    def precommit_timeout(self, round_: int) -> float:
+        return (self.timeout_precommit + self.timeout_precommit_delta * round_) / 1000.0
+
+    def commit_timeout(self) -> float:
+        return self.timeout_commit / 1000.0
+
+    @classmethod
+    def test_config(cls) -> "ConsensusConfig":
+        """Shrunk timeouts (reference `TestConsensusConfig
+        config/config.go:389-400`)."""
+        return cls(
+            timeout_propose=100,
+            timeout_propose_delta=1,
+            timeout_prevote=10,
+            timeout_prevote_delta=1,
+            timeout_precommit=10,
+            timeout_precommit_delta=1,
+            timeout_commit=10,
+            skip_timeout_commit=True,
+        )

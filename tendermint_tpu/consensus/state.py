@@ -1,0 +1,803 @@
+"""The BFT consensus state machine (reference `consensus/state.go`).
+
+Architecture: an event-sourced core — ONE thread (`_receive_loop`) owns
+the RoundState and serializes every input (peer message, internal
+message, timeout tock) exactly like the reference's `receiveRoutine`
+(`consensus/state.go:497-547`). Every input is WAL'd before processing.
+Public methods only enqueue; reads take a snapshot under the state lock.
+
+Transitions follow `consensus/state.go`: NewHeight → NewRound → Propose
+→ Prevote → PrevoteWait → Precommit → PrecommitWait → Commit, with the
+POL lock/unlock safety rules (`:963-1053`) and commit finalization
+(`:1078-1243`). Signature verification inside VoteSet/verify_commit
+routes through the BatchVerifier seam (TPU batch when available).
+
+Test seams (reference `consensus/state.go:107-110` + common_test.go):
+`decide_proposal_fn` / `do_prevote_fn` / `set_proposal_fn` are
+overridable, and any ticker implementing schedule/set_on_timeout/stop
+can be injected (MockTicker drives deterministic tests).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time as time_mod
+from dataclasses import dataclass
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.round_state import HeightVoteSet, RoundState, RoundStepType
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    EndHeightMessage,
+    MsgRecord,
+    RoundStateRecord,
+    TimeoutRecord,
+)
+from tendermint_tpu.state import apply_block
+from tendermint_tpu.state.state import State
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.errors import ErrDoubleSign, ValidationError
+from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.services import NopMempool
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
+from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.utils.fail import fail_point
+
+_SENTINEL = object()
+
+
+@dataclass
+class _TxsAvailable:
+    height: int
+
+
+class ConsensusState:
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        app_conn,
+        block_store,
+        mempool=None,
+        priv_validator=None,
+        event_switch=None,
+        wal_path: str | None = None,
+        ticker=None,
+        verifier=None,
+    ) -> None:
+        self.config = config
+        self.app_conn = app_conn
+        self.block_store = block_store
+        self.mempool = mempool if mempool is not None else NopMempool()
+        self.priv_validator = priv_validator
+        self.event_switch = event_switch if event_switch is not None else ev.EventSwitch()
+        self.verifier = verifier
+        self.wal = WAL(wal_path, light=config.wal_light) if wal_path else None
+
+        self._queue: "queue.Queue" = queue.Queue()
+        self._mtx = threading.RLock()
+        self._thread: threading.Thread | None = None
+        self._running = False
+
+        self.ticker = ticker if ticker is not None else TimeoutTicker()
+        self.ticker.set_on_timeout(self._enqueue_timeout)
+
+        # test hooks (reference overridable fields `consensus/state.go:107-110`)
+        self.decide_proposal_fn = self._default_decide_proposal
+        self.do_prevote_fn = self._default_do_prevote
+        self.set_proposal_fn = self._default_set_proposal
+
+        # RoundState
+        self.state: State = None  # type: ignore  # set by _update_to_state
+        self.height = 0
+        self.round = 0
+        self.step = RoundStepType.NEW_HEIGHT
+        self.start_time = 0.0
+        self.commit_time = 0.0
+        self.validators = None
+        self.proposal: Proposal | None = None
+        self.proposal_block: Block | None = None
+        self.proposal_block_parts: PartSet | None = None
+        self.locked_round = -1
+        self.locked_block: Block | None = None
+        self.locked_block_parts: PartSet | None = None
+        self.votes: HeightVoteSet | None = None
+        self.commit_round = -1
+        self.last_commit: VoteSet | None = None
+
+        self._update_to_state(state)
+        if hasattr(self.mempool, "set_on_txs_available"):
+            self.mempool.set_on_txs_available(self._on_txs_available)
+        # A brand-new WAL gets an ENDHEIGHT marker for the last committed
+        # height so crash recovery of the FIRST in-progress height finds
+        # its replay anchor (the reference seeds "#ENDHEIGHT: 0" likewise).
+        if self.wal is not None and os.path.getsize(self.wal.path) == 0:
+            self.wal.save(EndHeightMessage(state.last_block_height))
+
+    # ------------------------------------------------------------------ API
+
+    def start(self) -> None:
+        self._catchup_replay()
+        self._running = True
+        self._thread = threading.Thread(target=self._receive_loop, daemon=True)
+        self._thread.start()
+        self._schedule_round0()
+
+    def stop(self) -> None:
+        self._running = False
+        self.ticker.stop()
+        self._queue.put(_SENTINEL)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.wal is not None:
+            self.wal.close()
+
+    def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        self._queue.put(MsgRecord(vote, peer_id))
+
+    def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        self._queue.put(MsgRecord(proposal, peer_id))
+
+    def add_proposal_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str = ""
+    ) -> None:
+        self._queue.put(MsgRecord((height, round_, part), peer_id))
+
+    def get_round_state(self) -> RoundState:
+        with self._mtx:
+            return RoundState(
+                height=self.height,
+                round=self.round,
+                step=self.step,
+                start_time=self.start_time,
+                commit_time=self.commit_time,
+                validators=self.validators,
+                proposal=self.proposal,
+                proposal_block=self.proposal_block,
+                proposal_block_parts=self.proposal_block_parts,
+                locked_round=self.locked_round,
+                locked_block=self.locked_block,
+                locked_block_parts=self.locked_block_parts,
+                votes=self.votes,
+                commit_round=self.commit_round,
+                last_commit=self.last_commit,
+                last_validators=self.state.last_validators,
+            )
+
+    def is_proposer(self) -> bool:
+        if self.priv_validator is None:
+            return False
+        return self.validators.proposer.address == self.priv_validator.address
+
+    # ----------------------------------------------------------- the loop
+
+    def _receive_loop(self) -> None:
+        while self._running:
+            item = self._queue.get()
+            if item is _SENTINEL:
+                return
+            try:
+                with self._mtx:
+                    # _TxsAvailable is a local wakeup hint, not a consensus
+                    # input — it is not WAL'd (matches the reference, where
+                    # txsAvailable arrives on a separate non-WAL'd channel)
+                    if self.wal is not None and not isinstance(item, _TxsAvailable):
+                        self.wal.save(item)
+                    self._dispatch(item)
+            except ErrDoubleSign:
+                raise
+            except Exception as e:  # a bad peer message must not kill consensus
+                import traceback
+
+                traceback.print_exc()
+
+    def _dispatch(self, item) -> None:
+        if isinstance(item, MsgRecord):
+            m = item.msg
+            if isinstance(m, Vote):
+                self._handle_vote(m, item.peer_id)
+            elif isinstance(m, Proposal):
+                self.set_proposal_fn(m)
+            else:
+                height, round_, part = m
+                self._handle_block_part(height, round_, part)
+        elif isinstance(item, TimeoutRecord):
+            self._handle_timeout(
+                TimeoutInfo(item.duration, item.height, item.round, item.step)
+            )
+        elif isinstance(item, _TxsAvailable):
+            if item.height == self.height and self.step == RoundStepType.NEW_ROUND:
+                self._enter_propose(self.height, self.round)
+
+    def _enqueue_timeout(self, ti: TimeoutInfo) -> None:
+        self._queue.put(TimeoutRecord(ti.duration, ti.height, ti.round, ti.step))
+
+    def _on_txs_available(self) -> None:
+        self._queue.put(_TxsAvailable(self.height))
+
+    # ------------------------------------------------------ state plumbing
+
+    def _update_to_state(self, state: State) -> None:
+        """Reset the round state for state.last_block_height+1
+        (reference `updateToState consensus/state.go:415-477`)."""
+        if self.commit_round > -1 and 0 < self.height != state.last_block_height:
+            raise ValidationError(
+                f"updateToState expected height {self.height}, got {state.last_block_height}"
+            )
+        # last_commit: the precommits that committed the last block
+        last_commit = None
+        if state.last_block_height > 0:
+            if self.commit_round > -1 and self.votes is not None:
+                precommits = self.votes.precommits(self.commit_round)
+                if precommits is None or not precommits.has_two_thirds_majority():
+                    raise ValidationError("updateToState called with unfinished commit")
+                last_commit = precommits
+            else:
+                last_commit = self._reconstruct_last_commit(state)
+
+        self.state = state
+        self.height = state.last_block_height + 1
+        self.round = 0
+        self.step = RoundStepType.NEW_HEIGHT
+        now = time_mod.time()
+        if self.commit_time:
+            self.start_time = self.commit_time + self.config.commit_timeout()
+        else:
+            self.start_time = now + self.config.commit_timeout()
+        validators = state.validators.copy()
+        self.validators = validators
+        self.proposal = None
+        self.proposal_block = None
+        self.proposal_block_parts = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.votes = HeightVoteSet(state.chain_id, self.height, validators)
+        self.commit_round = -1
+        self.last_commit = last_commit
+
+    def _reconstruct_last_commit(self, state: State) -> VoteSet | None:
+        """Rebuild the precommit VoteSet from the stored seen-commit
+        (reference `consensus/state.go:392-411`)."""
+        if state.last_block_height == 0 or self.block_store is None:
+            return None
+        seen = self.block_store.load_seen_commit(state.last_block_height)
+        if seen is None:
+            raise ValidationError(
+                f"no seen commit for height {state.last_block_height}"
+            )
+        vs = VoteSet(
+            state.chain_id,
+            state.last_block_height,
+            seen.round(),
+            VOTE_TYPE_PRECOMMIT,
+            state.last_validators,
+        )
+        for v in seen.precommits:
+            if v is not None:
+                vs.add_vote(v, verifier=self.verifier)
+        if not vs.has_two_thirds_majority():
+            raise ValidationError("reconstructed last commit lacks +2/3")
+        return vs
+
+    def _catchup_replay(self) -> None:
+        """Replay WAL records for the in-progress height
+        (reference `consensus/replay.go:93-143`)."""
+        if self.wal is None:
+            return
+        records = WAL.records_since_last_end_height(self.wal.path, self.height)
+        if records is None:
+            return
+        saved_wal, self.wal = self.wal, None  # don't re-WAL replayed inputs
+        try:
+            for rec in records:
+                if isinstance(rec, (EndHeightMessage, RoundStateRecord)):
+                    continue
+                with self._mtx:
+                    self._dispatch(rec)
+        finally:
+            self.wal = saved_wal
+
+    # --------------------------------------------------------- scheduling
+
+    def _schedule_round0(self) -> None:
+        sleep = max(0.0, self.start_time - time_mod.time())
+        self.ticker.schedule(
+            TimeoutInfo(sleep, self.height, 0, RoundStepType.NEW_HEIGHT)
+        )
+
+    def _schedule_timeout(self, duration: float, height: int, round_: int, step: int) -> None:
+        self.ticker.schedule(TimeoutInfo(duration, height, round_, step))
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference `handleTimeout consensus/state.go:589-622`."""
+        if ti.height != self.height or ti.round < self.round or (
+            ti.round == self.round and ti.step < self.step
+        ):
+            return
+        if ti.step == RoundStepType.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == RoundStepType.NEW_ROUND:
+            # create_empty_blocks_interval expired while waiting for txs
+            self._enter_propose(ti.height, 0)
+        elif ti.step == RoundStepType.PROPOSE:
+            self.event_switch.fire(ev.EVENT_TIMEOUT_PROPOSE, self._rs_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStepType.PREVOTE_WAIT:
+            self.event_switch.fire(ev.EVENT_TIMEOUT_WAIT, self._rs_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStepType.PRECOMMIT_WAIT:
+            self.event_switch.fire(ev.EVENT_TIMEOUT_WAIT, self._rs_event())
+            self._enter_new_round(ti.height, ti.round + 1)
+
+    # -------------------------------------------------------- transitions
+
+    def _rs_event(self):
+        return ev.EventDataRoundState(
+            height=self.height, round=self.round, step=RoundStepType.name(self.step)
+        )
+
+    def _new_step(self) -> None:
+        if self.wal is not None:
+            self.wal.save(RoundStateRecord(self.height, self.round, self.step))
+        self.event_switch.fire(ev.EVENT_NEW_ROUND_STEP, self._rs_event())
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step != RoundStepType.NEW_HEIGHT
+        ):
+            return
+        validators = self.validators
+        if round_ > self.round:
+            validators = validators.copy()
+            validators.increment_accum(round_ - self.round)
+        self.validators = validators
+        self.round = round_
+        self.step = RoundStepType.NEW_ROUND
+        if round_ != 0:
+            # round 0 fields were reset by _update_to_state
+            self.proposal = None
+            self.proposal_block = None
+            self.proposal_block_parts = None
+        self.votes.set_round(round_ + 1)  # track next round for skipping
+        self.event_switch.fire(ev.EVENT_NEW_ROUND, self._rs_event())
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks
+            and round_ == 0
+            and not self.mempool.tx_available()
+        )
+        if wait_for_txs:
+            self.step = RoundStepType.NEW_ROUND  # wait; _TxsAvailable resumes
+            if self.config.create_empty_blocks_interval > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval,
+                    height,
+                    round_,
+                    RoundStepType.NEW_ROUND,
+                )
+            return
+        self._enter_propose(height, round_)
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step >= RoundStepType.PROPOSE
+        ):
+            return
+        self.round = round_
+        self.step = RoundStepType.PROPOSE
+        self._new_step()
+        self._schedule_timeout(
+            self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
+        )
+        if self.priv_validator is not None and self.is_proposer():
+            self.decide_proposal_fn(height, round_)
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+
+    def _default_decide_proposal(self, height: int, round_: int) -> None:
+        """Reference `defaultDecideProposal :787-827`."""
+        if self.locked_block is not None:
+            block, parts = self.locked_block, self.locked_block_parts
+        else:
+            made = self._create_proposal_block()
+            if made is None:
+                return
+            block, parts = made
+        pol_round, pol_block_id = self.votes.pol_info()
+        proposal = Proposal(
+            height=height,
+            round=round_,
+            block_parts_header=parts.header,
+            pol_round=pol_round,
+            pol_block_id=pol_block_id if pol_block_id is not None else BlockID.zero(),
+            timestamp=time_mod.time_ns(),
+        )
+        try:
+            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except ErrDoubleSign:
+            return
+        # send to ourselves (internal queue, no peer id)
+        self.set_proposal(proposal, "")
+        for i in range(parts.total):
+            self.add_proposal_block_part(height, round_, parts.get_part(i), "")
+
+    def _create_proposal_block(self) -> tuple[Block, PartSet] | None:
+        """Reference `createProposalBlock :848-868`."""
+        if self.height == 1:
+            last_commit = Commit.empty()
+        elif self.last_commit is not None and self.last_commit.has_two_thirds_majority():
+            last_commit = self.last_commit.make_commit()
+        else:
+            return None  # can't propose without the last commit
+        txs = self.mempool.reap(self.config.max_block_size_txs)
+        block = Block.make_block(
+            height=self.height,
+            chain_id=self.state.chain_id,
+            txs=Txs(txs),
+            last_commit=last_commit,
+            last_block_id=self.state.last_block_id,
+            time=time_mod.time_ns(),
+            validators_hash=self.state.validators.hash(),
+            app_hash=self.state.app_hash,
+        )
+        return block, block.make_part_set(
+            self.state.consensus_params.block_gossip.block_part_size_bytes
+        )
+
+    def _default_set_proposal(self, proposal: Proposal) -> None:
+        """Reference `defaultSetProposal :1247-1278`."""
+        if self.proposal is not None:
+            return
+        if proposal.height != self.height or proposal.round != self.round:
+            return
+        if not (-1 <= proposal.pol_round < proposal.round):
+            raise ValidationError("proposal POLRound out of range")
+        proposer = self.validators.proposer
+        if not proposer.pub_key.verify(
+            proposal.sign_bytes(self.state.chain_id), proposal.signature
+        ):
+            raise ValidationError("invalid proposal signature")
+        self.proposal = proposal
+        if self.proposal_block_parts is None:
+            self.proposal_block_parts = PartSet.from_header(proposal.block_parts_header)
+
+    def _handle_block_part(self, height: int, round_: int, part: Part) -> None:
+        """Reference `addProposalBlockPart :1282-1315`."""
+        if height != self.height or self.proposal_block_parts is None:
+            return
+        try:
+            added = self.proposal_block_parts.add_part(part)
+        except ValidationError:
+            return
+        if not added or not self.proposal_block_parts.is_complete():
+            return
+        buf = b"".join(
+            self.proposal_block_parts.get_part(i).bytes_
+            for i in range(self.proposal_block_parts.total)
+        )
+        self.proposal_block = Block.decode(buf)
+        self.event_switch.fire(ev.EVENT_COMPLETE_PROPOSAL, self._rs_event())
+        prevotes = self.votes.prevotes(self.round)
+        bid = prevotes.two_thirds_majority() if prevotes is not None else None
+        if bid is not None and not bid.is_zero() and self.step <= RoundStepType.PREVOTE:
+            # +2/3 already prevoted this block before we had it
+            self._enter_prevote(height, self.round)
+        elif self.step == RoundStepType.PROPOSE and self._is_proposal_complete():
+            self._enter_prevote(height, self.round)
+        elif self.step == RoundStepType.COMMIT:
+            self._try_finalize_commit(height)
+
+    def _is_proposal_complete(self) -> bool:
+        if self.proposal is None or self.proposal_block is None:
+            return False
+        if self.proposal.pol_round < 0:
+            return True
+        prevotes = self.votes.prevotes(self.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step >= RoundStepType.PREVOTE
+        ):
+            return
+        self.round = round_
+        self.step = RoundStepType.PREVOTE
+        self._new_step()
+        self.do_prevote_fn(height, round_)
+
+    def _default_do_prevote(self, height: int, round_: int) -> None:
+        """Reference `defaultDoPrevote :875-908`."""
+        if self.locked_block is not None:
+            self._sign_add_vote(
+                VOTE_TYPE_PREVOTE,
+                self.locked_block.hash(),
+                self.locked_block_parts.header,
+            )
+            return
+        if self.proposal_block is None:
+            self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", PartSetHeader.zero())
+            return
+        try:
+            from tendermint_tpu.state import validate_block
+
+            validate_block(self.state, self.proposal_block, verifier=self.verifier)
+        except ValidationError:
+            self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", PartSetHeader.zero())
+            return
+        self._sign_add_vote(
+            VOTE_TYPE_PREVOTE,
+            self.proposal_block.hash(),
+            self.proposal_block_parts.header,
+        )
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step >= RoundStepType.PREVOTE_WAIT
+        ):
+            return
+        self.round = round_
+        self.step = RoundStepType.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote_timeout(round_), height, round_, RoundStepType.PREVOTE_WAIT
+        )
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """Reference `enterPrecommit :963-1053` — the POL lock logic."""
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step >= RoundStepType.PRECOMMIT
+        ):
+            return
+        self.round = round_
+        self.step = RoundStepType.PRECOMMIT
+        self._new_step()
+
+        prevotes = self.votes.prevotes(round_)
+        block_id = prevotes.two_thirds_majority() if prevotes is not None else None
+
+        if block_id is None:
+            # no polka: precommit nil
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", PartSetHeader.zero())
+            return
+
+        self.event_switch.fire(ev.EVENT_POLKA, self._rs_event())
+
+        if block_id.is_zero():
+            # polka for nil: unlock if locked
+            if self.locked_block is not None:
+                self.locked_round = -1
+                self.locked_block = None
+                self.locked_block_parts = None
+                self.event_switch.fire(ev.EVENT_UNLOCK, self._rs_event())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", PartSetHeader.zero())
+            return
+
+        if self.locked_block is not None and self.locked_block.hash_to(block_id.hash):
+            # relock
+            self.locked_round = round_
+            self.event_switch.fire(ev.EVENT_RELOCK, self._rs_event())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            return
+
+        if self.proposal_block is not None and self.proposal_block.hash_to(block_id.hash):
+            # lock the polka block (it must validate)
+            from tendermint_tpu.state import validate_block
+
+            try:
+                validate_block(self.state, self.proposal_block, verifier=self.verifier)
+            except ValidationError as e:
+                raise ValidationError(f"+2/3 prevoted an invalid block: {e}") from e
+            self.locked_round = round_
+            self.locked_block = self.proposal_block
+            self.locked_block_parts = self.proposal_block_parts
+            self.event_switch.fire(ev.EVENT_LOCK, self._rs_event())
+            self._sign_add_vote(VOTE_TYPE_PRECOMMIT, block_id.hash, block_id.parts_header)
+            return
+
+        # polka for a block we don't have: unlock, fetch it, precommit nil
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        if self.proposal_block_parts is None or not self.proposal_block_parts.has_header(
+            block_id.parts_header
+        ):
+            self.proposal_block = None
+            self.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+        self.event_switch.fire(ev.EVENT_UNLOCK, self._rs_event())
+        self._sign_add_vote(VOTE_TYPE_PRECOMMIT, b"", PartSetHeader.zero())
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        if height != self.height or round_ < self.round or (
+            round_ == self.round and self.step >= RoundStepType.PRECOMMIT_WAIT
+        ):
+            return
+        self.round = round_
+        self.step = RoundStepType.PRECOMMIT_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit_timeout(round_),
+            height,
+            round_,
+            RoundStepType.PRECOMMIT_WAIT,
+        )
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference `enterCommit :1078-1143`."""
+        if height != self.height or self.step >= RoundStepType.COMMIT:
+            return
+        self.commit_round = commit_round
+        self.commit_time = time_mod.time()
+        self.step = RoundStepType.COMMIT
+        self._new_step()
+
+        block_id = self.votes.precommits(commit_round).two_thirds_majority()
+        if block_id is None or block_id.is_zero():
+            raise ValidationError("enterCommit without +2/3 precommits")
+        if self.locked_block is not None and self.locked_block.hash_to(block_id.hash):
+            self.proposal_block = self.locked_block
+            self.proposal_block_parts = self.locked_block_parts
+        if self.proposal_block is None or not self.proposal_block.hash_to(block_id.hash):
+            if self.proposal_block_parts is None or not self.proposal_block_parts.has_header(
+                block_id.parts_header
+            ):
+                # we don't have the committed block: fetch via gossip
+                self.proposal_block = None
+                self.proposal_block_parts = PartSet.from_header(block_id.parts_header)
+                return
+        self._try_finalize_commit(height)
+
+    def _try_finalize_commit(self, height: int) -> None:
+        block_id = self.votes.precommits(self.commit_round).two_thirds_majority()
+        if block_id is None or block_id.is_zero():
+            return
+        if self.proposal_block is None or not self.proposal_block.hash_to(block_id.hash):
+            return  # wait for gossip to complete the block
+        self._finalize_commit(height)
+
+    def _finalize_commit(self, height: int) -> None:
+        """Reference `finalizeCommit :1146-1243` with fail points
+        bracketing every persistence step."""
+        block = self.proposal_block
+        parts = self.proposal_block_parts
+        block_id = self.votes.precommits(self.commit_round).two_thirds_majority()
+        assert block is not None and block.hash_to(block_id.hash)
+
+        fail_point()  # before block save
+        if self.block_store is not None and self.block_store.height < height:
+            seen_commit = self.votes.precommits(self.commit_round).make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+
+        fail_point()  # block saved, before WAL ENDHEIGHT
+        if self.wal is not None:
+            self.wal.save(EndHeightMessage(height))
+
+        fail_point()  # ENDHEIGHT written, before ApplyBlock
+        state_copy = self.state.copy()
+        apply_block(
+            state_copy,
+            block,
+            parts.header,
+            self.app_conn,
+            mempool=self.mempool,
+            verifier=self.verifier,
+        )
+
+        self.event_switch.fire(ev.EVENT_NEW_BLOCK, ev.EventDataNewBlock(block))
+        self.event_switch.fire(
+            ev.EVENT_NEW_BLOCK_HEADER, ev.EventDataNewBlockHeader(block.header)
+        )
+
+        fail_point()  # applied, before round-state reset
+        self._update_to_state(state_copy)
+        self._schedule_round0()
+
+    # ---------------------------------------------------------------- votes
+
+    def _handle_vote(self, vote: Vote, peer_id: str) -> None:
+        """Reference `tryAddVote/addVote :1318-1453`."""
+        # LastCommit catchup: precommit for height-1 while in NewHeight step
+        if vote.height + 1 == self.height:
+            if (
+                self.step == RoundStepType.NEW_HEIGHT
+                and vote.type == VOTE_TYPE_PRECOMMIT
+                and self.last_commit is not None
+            ):
+                if self.last_commit.add_vote(vote, verifier=self.verifier):
+                    self.event_switch.fire(ev.EVENT_VOTE, ev.EventDataVote(vote))
+            return
+        if vote.height != self.height:
+            return
+
+        added = self.votes.add_vote(vote, peer_id, verifier=self.verifier)
+        if not added:
+            return
+        self.event_switch.fire(ev.EVENT_VOTE, ev.EventDataVote(vote))
+
+        if vote.type == VOTE_TYPE_PREVOTE:
+            self._on_prevote_added(vote)
+        elif vote.type == VOTE_TYPE_PRECOMMIT:
+            self._on_precommit_added(vote)
+
+    def _on_prevote_added(self, vote: Vote) -> None:
+        prevotes = self.votes.prevotes(vote.round)
+        block_id = prevotes.two_thirds_majority()
+
+        # POL unlock (reference `:1400-1420`): a newer-round polka for a
+        # different block releases our lock.
+        if (
+            self.locked_block is not None
+            and self.locked_round < vote.round <= self.round
+            and block_id is not None
+            and not self.locked_block.hash_to(block_id.hash)
+        ):
+            self.locked_round = -1
+            self.locked_block = None
+            self.locked_block_parts = None
+            self.event_switch.fire(ev.EVENT_UNLOCK, self._rs_event())
+
+        if self.round < vote.round and prevotes.has_two_thirds_any():
+            # round skip
+            self._enter_new_round(self.height, vote.round)
+        elif self.round == vote.round:
+            if block_id is not None and (
+                self._is_proposal_complete() or block_id.is_zero()
+            ):
+                self._enter_precommit(self.height, vote.round)
+            elif prevotes.has_two_thirds_any() and self.step == RoundStepType.PREVOTE:
+                self._enter_prevote_wait(self.height, vote.round)
+        elif (
+            self.proposal is not None
+            and 0 <= self.proposal.pol_round == vote.round
+            and self._is_proposal_complete()
+        ):
+            self._enter_prevote(self.height, self.round)
+
+    def _on_precommit_added(self, vote: Vote) -> None:
+        precommits = self.votes.precommits(vote.round)
+        block_id = precommits.two_thirds_majority()
+        if block_id is not None:
+            self._enter_new_round(self.height, vote.round)
+            self._enter_precommit(self.height, vote.round)
+            if not block_id.is_zero():
+                self._enter_commit(self.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self._enter_new_round(self.height, 0)
+            else:
+                self._enter_precommit_wait(self.height, vote.round)
+        elif self.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(self.height, vote.round)
+            self._enter_precommit_wait(self.height, vote.round)
+
+    def _sign_add_vote(self, type_: int, hash_: bytes, header: PartSetHeader) -> None:
+        """Reference `signAddVote :1471-1487`."""
+        if self.priv_validator is None or not self.validators.has_address(
+            self.priv_validator.address
+        ):
+            return
+        idx, _ = self.validators.get_by_address(self.priv_validator.address)
+        vote = Vote(
+            validator_address=self.priv_validator.address,
+            validator_index=idx,
+            height=self.height,
+            round=self.round,
+            timestamp=time_mod.time_ns(),
+            type=type_,
+            block_id=BlockID(hash_, header),
+        )
+        try:
+            vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except ErrDoubleSign:
+            return
+        # handle immediately (we're already on the consensus thread);
+        # WAL it like any other input
+        if self.wal is not None:
+            self.wal.save(MsgRecord(vote, ""))
+        self._handle_vote(vote, "")

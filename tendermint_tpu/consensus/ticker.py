@@ -1,0 +1,90 @@
+"""Timeout scheduling (reference `consensus/ticker.go`).
+
+One timer; a scheduled timeout replaces any older one and only fires if
+still relevant (>= the height/round/step it was scheduled for). Tocks
+land on the consensus message queue like any other input.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+
+@dataclass(frozen=True)
+class TimeoutInfo:
+    duration: float  # seconds
+    height: int
+    round: int
+    step: int
+
+    def _key(self):
+        return (self.height, self.round, self.step)
+
+
+class TimeoutTicker:
+    """Thread-timer implementation of the tick/tock contract."""
+
+    def __init__(self) -> None:
+        self._timer: threading.Timer | None = None
+        self._current: TimeoutInfo | None = None
+        self._lock = threading.Lock()
+        self._on_timeout: Callable[[TimeoutInfo], None] | None = None
+        self._stopped = False
+
+    def set_on_timeout(self, fn: Callable[[TimeoutInfo], None]) -> None:
+        self._on_timeout = fn
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        """Replace the pending timeout unless it's for an older HRS
+        (reference ticker ignores ticks for the past)."""
+        with self._lock:
+            if self._stopped:
+                return
+            if self._current is not None and ti._key() < self._current._key():
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._current = ti
+            self._timer = threading.Timer(ti.duration, self._fire, args=(ti,))
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        with self._lock:
+            if self._stopped or self._current is not ti:
+                return
+            self._current = None
+        if self._on_timeout is not None:
+            self._on_timeout(ti)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+
+
+class MockTicker:
+    """Test ticker (reference `consensus/common_test.go:435-478`): only
+    fires NewHeight timeouts (step 1) immediately-ish; tests drive every
+    other transition by injecting messages."""
+
+    def __init__(self, fire_steps: tuple[int, ...] = (1,)) -> None:
+        self._on_timeout: Callable[[TimeoutInfo], None] | None = None
+        self.fire_steps = fire_steps
+        self.scheduled: list[TimeoutInfo] = []
+
+    def set_on_timeout(self, fn: Callable[[TimeoutInfo], None]) -> None:
+        self._on_timeout = fn
+
+    def schedule(self, ti: TimeoutInfo) -> None:
+        self.scheduled.append(ti)
+        if ti.step in self.fire_steps and self._on_timeout is not None:
+            # fire on a fresh thread to mimic the async tock channel
+            t = threading.Thread(target=self._on_timeout, args=(ti,), daemon=True)
+            t.start()
+
+    def stop(self) -> None:
+        pass

@@ -1,0 +1,189 @@
+"""Consensus write-ahead log (reference `consensus/wal.go`).
+
+Every consensus input (peer/internal message, timeout) is persisted
+*before* processing (`consensus/state.go:519-528`), so a crash at any
+point replays deterministically. Records are CRC32-framed:
+
+    [crc32 u32 BE][length u32 BE][type u8][payload]
+
+`#ENDHEIGHT` markers delimit completed heights; on restart, replay
+starts after the last marker (`SearchForEndHeight :122`). `light` mode
+skips persisting peer block-parts (reference `wal.go:97-104`).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator
+
+from tendermint_tpu.codec import Reader, Writer
+from tendermint_tpu.types.part_set import Part
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.vote import Vote
+
+# record types
+_T_END_HEIGHT = 0x01
+_T_VOTE = 0x02
+_T_PROPOSAL = 0x03
+_T_BLOCK_PART = 0x04
+_T_TIMEOUT = 0x05
+_T_ROUND_STATE = 0x06
+
+
+@dataclass(frozen=True)
+class EndHeightMessage:
+    height: int
+
+
+@dataclass(frozen=True)
+class TimeoutRecord:
+    duration: float
+    height: int
+    round: int
+    step: int
+
+
+@dataclass(frozen=True)
+class RoundStateRecord:
+    """Step-transition marker (the reference WALs EventDataRoundState)."""
+
+    height: int
+    round: int
+    step: int
+
+
+@dataclass(frozen=True)
+class MsgRecord:
+    """A consensus input: vote/proposal/block-part + its origin peer."""
+
+    msg: object  # Vote | Proposal | (height, round, Part)
+    peer_id: str
+
+
+def _encode_record(item) -> bytes:
+    if isinstance(item, EndHeightMessage):
+        return bytes([_T_END_HEIGHT]) + Writer().uvarint(item.height).build()
+    if isinstance(item, TimeoutRecord):
+        payload = (
+            Writer()
+            .uvarint(int(item.duration * 1e6))
+            .uvarint(item.height)
+            .uvarint(item.round)
+            .uvarint(item.step)
+            .build()
+        )
+        return bytes([_T_TIMEOUT]) + payload
+    if isinstance(item, RoundStateRecord):
+        payload = Writer().uvarint(item.height).uvarint(item.round).uvarint(item.step).build()
+        return bytes([_T_ROUND_STATE]) + payload
+    if isinstance(item, MsgRecord):
+        m = item.msg
+        if isinstance(m, Vote):
+            body = bytes([_T_VOTE]) + Writer().string(item.peer_id).bytes(m.encode()).build()
+        elif isinstance(m, Proposal):
+            body = bytes([_T_PROPOSAL]) + Writer().string(item.peer_id).bytes(m.encode()).build()
+        else:
+            height, round_, part = m
+            payload = (
+                Writer()
+                .string(item.peer_id)
+                .uvarint(height)
+                .uvarint(round_)
+                .bytes(part.encode())
+                .build()
+            )
+            body = bytes([_T_BLOCK_PART]) + payload
+        return body
+    raise TypeError(f"cannot WAL {type(item)}")
+
+
+def _decode_record(data: bytes):
+    t, payload = data[0], data[1:]
+    r = Reader(payload)
+    if t == _T_END_HEIGHT:
+        return EndHeightMessage(r.uvarint())
+    if t == _T_TIMEOUT:
+        dur = r.uvarint() / 1e6
+        return TimeoutRecord(dur, r.uvarint(), r.uvarint(), r.uvarint())
+    if t == _T_ROUND_STATE:
+        return RoundStateRecord(r.uvarint(), r.uvarint(), r.uvarint())
+    if t == _T_VOTE:
+        peer = r.string()
+        return MsgRecord(Vote.decode(r.bytes()), peer)
+    if t == _T_PROPOSAL:
+        peer = r.string()
+        return MsgRecord(Proposal.decode(r.bytes()), peer)
+    if t == _T_BLOCK_PART:
+        peer = r.string()
+        return MsgRecord((r.uvarint(), r.uvarint(), Part.decode(r.bytes())), peer)
+    raise ValueError(f"unknown WAL record type {t}")
+
+
+class WAL:
+    def __init__(self, path: str, light: bool = False) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.light = light
+        self._f = open(path, "ab")
+
+    def save(self, item) -> None:
+        """Frame + append + fsync (writes happen BEFORE processing)."""
+        if self.light and isinstance(item, MsgRecord) and isinstance(item.msg, tuple):
+            if item.peer_id != "":
+                return  # light mode: drop peer block-parts
+        body = _encode_record(item)
+        frame = struct.pack(">II", zlib.crc32(body) & 0xFFFFFFFF, len(body)) + body
+        self._f.write(frame)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()
+
+    # -- reading --------------------------------------------------------------
+
+    @staticmethod
+    def iter_records(path: str) -> Iterator[object]:
+        """Decode records; stops cleanly at a truncated/corrupt tail
+        (a crash mid-write must not poison recovery)."""
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + 8 <= len(data):
+            crc, length = struct.unpack_from(">II", data, off)
+            if off + 8 + length > len(data):
+                return  # truncated tail
+            body = data[off + 8 : off + 8 + length]
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                return  # corrupt tail
+            try:
+                yield _decode_record(body)
+            except Exception:
+                return
+            off += 8 + length
+
+    @classmethod
+    def records_since_last_end_height(cls, path: str, height: int) -> list[object] | None:
+        """Records after `#ENDHEIGHT <height-1>` — the inputs to replay
+        for an in-progress `height`. None if no marker for height-1
+        exists (reference `SearchForEndHeight :122`)."""
+        if not os.path.exists(path):
+            return None
+        found = False
+        out: list[object] = []
+        for rec in cls.iter_records(path):
+            if isinstance(rec, EndHeightMessage):
+                if rec.height == height - 1:
+                    found = True
+                    out = []
+                elif rec.height >= height:
+                    # marker for a later height exists: caller's height is stale
+                    found = True
+                    out = []
+                continue
+            if found:
+                out.append(rec)
+        return out if found else None

@@ -1,0 +1,5 @@
+"""Mempool (reference `mempool/`)."""
+
+from tendermint_tpu.mempool.mempool import Mempool, TxCache
+
+__all__ = ["Mempool", "TxCache"]

@@ -1,0 +1,217 @@
+"""Mempool: concurrent tx intake, dedup, ABCI validation, reaping.
+
+Reference `mempool/mempool.go`: LRU dup-cache (100k), append-only
+mempool WAL, ABCI CheckTx validation, ordered good-tx list consumed by
+the proposer (`Reap :303`) and per-peer gossip routines; `Update :334`
+removes committed txs and *rechecks* the remainder through the app.
+The reference's lock-free clist becomes a version-counted list guarded
+by the mempool mutex — gossip readers iterate by index and block on a
+Condition for new entries (`TxsFront/NextWait`'s role).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable
+
+from tendermint_tpu.abci.client import AppConnMempool
+from tendermint_tpu.abci.types import Result
+from tendermint_tpu.types.tx import Tx, Txs
+
+DEFAULT_CACHE_SIZE = 100_000
+
+
+class TxCache:
+    """Bounded FIFO-evicting dup cache (reference `txCache :414-474`)."""
+
+    def __init__(self, size: int = DEFAULT_CACHE_SIZE) -> None:
+        self._size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present (and does not re-add)."""
+        with self._lock:
+            if tx in self._map:
+                return False
+            if len(self._map) >= self._size:
+                self._map.popitem(last=False)
+            self._map[tx] = None
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._lock:
+            self._map.pop(tx, None)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+
+@dataclass
+class MempoolTx:
+    counter: int
+    height: int  # height when added
+    tx: bytes
+
+
+class Mempool:
+    """Implements `types.services.MempoolI`."""
+
+    def __init__(
+        self,
+        app_conn: AppConnMempool,
+        height: int = 0,
+        cache_size: int = DEFAULT_CACHE_SIZE,
+        wal_dir: str | None = None,
+        recheck: bool = True,
+    ) -> None:
+        self._app = app_conn
+        self._txs: list[MempoolTx] = []
+        self._lock = threading.RLock()
+        self._txs_available = threading.Condition(self._lock)
+        self._counter = 0
+        self._height = height
+        self._cache = TxCache(cache_size)
+        self._recheck = recheck
+        self._notified_available = False
+        self._fire_available: Callable[[], None] | None = None
+        self._wal = None
+        if wal_dir:
+            os.makedirs(wal_dir, exist_ok=True)
+            self._wal = open(os.path.join(wal_dir, "wal"), "ab")
+
+    # -- MempoolI ------------------------------------------------------------
+
+    def lock(self) -> None:
+        self._lock.acquire()
+
+    def unlock(self) -> None:
+        self._lock.release()
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._txs)
+
+    def flush(self) -> None:
+        """Drop everything (unsafe_flush_mempool RPC)."""
+        with self._lock:
+            self._txs.clear()
+            self._cache.reset()
+
+    def check_tx(self, tx: Tx, cb: Callable[[Result], None] | None = None) -> Result:
+        """Validate through the app; good txs join the pool.
+
+        Returns the CheckTx result (the reference returns err only for
+        cache hits / full pool; the result flows via callback).
+        """
+        tx = bytes(tx)
+        if not self._cache.push(tx):
+            res = Result(code=0, log="tx already exists in cache")
+            if cb is not None:
+                cb(res)
+            return res
+        if self._wal is not None:
+            # length-framed (txs are arbitrary bytes); buffered+flushed but
+            # NOT fsync'd per tx — the mempool WAL is best-effort, unlike
+            # the consensus WAL (matches the reference's autofile writer)
+            from tendermint_tpu.codec.binary import encode_bytes
+
+            self._wal.write(encode_bytes(tx))
+            self._wal.flush()
+        res = self._app.check_tx_async(tx)
+        if res.is_ok:
+            with self._lock:
+                self._counter += 1
+                self._txs.append(MempoolTx(self._counter, self._height, tx))
+                self._notify_txs_available()
+                self._txs_available.notify_all()
+        else:
+            # bad tx: evict from cache so a corrected app state can re-admit
+            self._cache.remove(tx)
+        if cb is not None:
+            cb(res)
+        return res
+
+    def reap(self, max_txs: int) -> Txs:
+        """Up to max_txs txs for a proposal (-1 = all), pool unchanged
+        (reference `Reap :303`)."""
+        with self._lock:
+            txs = self._txs if max_txs < 0 else self._txs[:max_txs]
+            return Txs([Tx(m.tx) for m in txs])
+
+    def update(self, height: int, txs: Txs) -> None:
+        """Remove committed txs; recheck survivors against the new app
+        state (reference `Update :334-360`). Caller holds the mempool
+        lock (apply_block's CommitStateUpdateMempool)."""
+        committed = {bytes(t) for t in txs}
+        with self._lock:
+            self._height = height
+            self._notified_available = False
+            keep = [m for m in self._txs if m.tx not in committed]
+            if self._recheck and keep:
+                still_good = []
+                for m in keep:
+                    if self._app.check_tx_async(m.tx).is_ok:
+                        still_good.append(m)
+                    else:
+                        self._cache.remove(m.tx)
+                keep = still_good
+            self._txs = keep
+            if keep:
+                self._notify_txs_available()
+
+    # -- gossip / proposer wakeups -------------------------------------------
+
+    def tx_available(self) -> bool:
+        with self._lock:
+            return len(self._txs) > 0
+
+    def enable_txs_available(self) -> None:
+        """Install no-empty-blocks gating (reference `:101-106`).
+        Consensus sets `on_txs_available` to get woken."""
+        self._fire_available = self._fire_available or (lambda: None)
+
+    def set_on_txs_available(self, fn: Callable[[], None]) -> None:
+        self._fire_available = fn
+
+    def _notify_txs_available(self) -> None:
+        """Fire once per height when the pool becomes non-empty
+        (reference `notifyTxsAvailable :284-299`)."""
+        if self._fire_available is not None and not self._notified_available:
+            self._notified_available = True
+            self._fire_available()
+
+    def get_after(self, index: int, wait: bool = False, timeout: float | None = None) -> list[bytes]:
+        """Txs at positions > index — the gossip iteration seam
+        (role of clist's TxsFront/NextWait). With wait=True blocks until
+        a tx beyond `index` exists or timeout."""
+        with self._lock:
+            if wait and len(self._txs) <= index:
+                self._txs_available.wait(timeout)
+            return [m.tx for m in self._txs[index:]]
+
+    def close(self) -> None:
+        if self._wal is not None:
+            self._wal.close()
+
+    def load_wal(self) -> list[bytes]:
+        """Replay the mempool WAL (txs seen before a crash); stops at a
+        truncated tail."""
+        if self._wal is None:
+            return []
+        from tendermint_tpu.codec.binary import decode_bytes
+
+        with open(self._wal.name, "rb") as f:
+            data = f.read()
+        out, off = [], 0
+        while off < len(data):
+            try:
+                tx, off = decode_bytes(data, off)
+            except ValueError:
+                break
+            out.append(tx)
+        return out

@@ -1,0 +1,316 @@
+"""Consensus state machine: progress, locking safety, WAL crash recovery.
+
+Port of the reference harness pattern (`consensus/common_test.go`):
+MockTicker fires only height-start timeouts; tests drive all other
+transitions by injecting signed votes directly.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.client import local_client_creator
+from tendermint_tpu.blockchain import BlockStore
+from tendermint_tpu.consensus import (
+    ConsensusConfig,
+    ConsensusState,
+    MockTicker,
+    TimeoutTicker,
+)
+from tendermint_tpu.consensus.round_state import RoundStepType
+from tendermint_tpu.consensus.wal import WAL, EndHeightMessage, MsgRecord
+from tendermint_tpu.db.kv import MemDB
+from tendermint_tpu.state import make_genesis_state
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.priv_validator import PrivValidator
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
+
+from tests.helpers import make_genesis
+
+CHAIN = "cons-test"
+
+
+class Fixture:
+    """One in-process consensus node + scripted co-validators."""
+
+    def __init__(
+        self,
+        n_vals=4,
+        wal_path=None,
+        db=None,
+        store_db=None,
+        config=None,
+        real_ticker=False,
+    ):
+        self.genesis, self.privs = make_genesis(n_vals, chain_id=CHAIN)
+        self.db = db if db is not None else MemDB()
+        self.store = BlockStore(store_db if store_db is not None else MemDB())
+        state = make_genesis_state(self.db, self.genesis)
+        state.save()
+        self.app = KVStoreApp()
+        conns = local_client_creator(self.app)()
+        self.config = config or ConsensusConfig.test_config()
+        # our validator is privs[0] (valset order)
+        self.cs = ConsensusState(
+            config=self.config,
+            state=state,
+            app_conn=conns.consensus,
+            block_store=self.store,
+            priv_validator=self.privs[0],
+            wal_path=wal_path,
+            ticker=TimeoutTicker() if real_ticker else MockTicker(),
+        )
+        self.events: "queue.Queue[tuple[str, object]]" = queue.Queue()
+        for name in (
+            ev.EVENT_NEW_ROUND_STEP,
+            ev.EVENT_NEW_BLOCK,
+            ev.EVENT_LOCK,
+            ev.EVENT_UNLOCK,
+            ev.EVENT_RELOCK,
+            ev.EVENT_POLKA,
+        ):
+            self.cs.event_switch.add_listener(
+                "test", name, lambda data, n=name: self.events.put((n, data))
+            )
+
+    def wait_event(self, name, timeout=10.0, pred=None):
+        deadline = time.time() + timeout
+        while True:
+            remaining = deadline - time.time()
+            assert remaining > 0, f"timed out waiting for {name}"
+            got, data = self.events.get(timeout=remaining)
+            if got == name and (pred is None or pred(data)):
+                return data
+
+    def wait_step(self, step_name, timeout=10.0):
+        return self.wait_event(
+            ev.EVENT_NEW_ROUND_STEP, timeout, lambda d: d.step == step_name
+        )
+
+    def wait_height(self, height, timeout=20.0):
+        while True:
+            data = self.wait_event(ev.EVENT_NEW_BLOCK, timeout)
+            if data.block.header.height >= height:
+                return data.block
+
+    def inject_votes(self, type_, block_id, val_indices, height=None, round_=0):
+        """Sign + inject votes from co-validators (scripted signers)."""
+        height = height if height is not None else self.cs.height
+        for i in val_indices:
+            vote = Vote(
+                validator_address=self.privs[i].address,
+                validator_index=i,
+                height=height,
+                round=round_,
+                timestamp=time.time_ns(),
+                type=type_,
+                block_id=block_id,
+            )
+            vote = self.privs[i].sign_vote(CHAIN, vote)
+            self.cs.add_vote(vote, peer_id=f"peer{i}")
+
+    def proposal_block_id(self, timeout=10.0):
+        """Wait until our node has a complete proposal block; return its id."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            rs = self.cs.get_round_state()
+            if rs.proposal_block is not None:
+                return BlockID(
+                    rs.proposal_block.hash(), rs.proposal_block_parts.header
+                )
+            time.sleep(0.01)
+        raise AssertionError("no complete proposal block")
+
+    def stop(self):
+        self.cs.stop()
+
+
+class TestSoloValidator:
+    def test_commits_blocks_alone(self):
+        f = Fixture(n_vals=1)
+        try:
+            f.cs.start()
+            block = f.wait_height(3)
+            assert block.header.height >= 3
+            assert f.store.height >= 3
+            assert f.cs.state.last_block_height >= 3
+        finally:
+            f.stop()
+
+    def test_app_state_follows(self):
+        f = Fixture(n_vals=1)
+        try:
+            f.cs.start()
+            f.wait_height(2)
+            assert f.app._height >= 2 or f.cs.state.app_hash == b""
+        finally:
+            f.stop()
+
+
+class TestQuorumProgress:
+    def test_four_validators_commit_with_injected_votes(self):
+        f = Fixture(n_vals=4)
+        try:
+            f.cs.start()
+            # we are one of 4 proposers; wait for OUR proposal at h1 r0
+            # (privs[0] proposes round 0 by accum rotation from genesis)
+            bid = f.proposal_block_id()
+            f.inject_votes(VOTE_TYPE_PREVOTE, bid, [1, 2, 3])
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, bid, [1, 2, 3])
+            block = f.wait_height(1)
+            assert block.header.height == 1
+            # seen commit persisted
+            assert f.store.load_seen_commit(1).is_commit()
+        finally:
+            f.stop()
+
+    def test_nil_precommits_go_to_next_round(self):
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            f.proposal_block_id()
+            nil = BlockID(b"", PartSetHeader.zero())
+            # everyone prevotes+precommits nil -> next round, same height
+            f.inject_votes(VOTE_TYPE_PREVOTE, nil, [1, 2, 3])
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, nil, [1, 2, 3])
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                rs = f.cs.get_round_state()
+                if rs.round >= 1:
+                    break
+                time.sleep(0.01)
+            assert f.cs.get_round_state().round >= 1
+            assert f.cs.get_round_state().height == 1
+        finally:
+            f.stop()
+
+
+class TestLocking:
+    def test_lock_held_against_different_block_next_round(self):
+        """Once locked by a polka, we must keep prevoting the locked
+        block in later rounds (reference TestLockNoPOL essence)."""
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            bid = f.proposal_block_id()
+            # polka for our block at round 0 -> we lock
+            f.inject_votes(VOTE_TYPE_PREVOTE, bid, [1, 2, 3])
+            f.wait_event(ev.EVENT_LOCK)
+            rs = f.cs.get_round_state()
+            assert rs.locked_round == 0
+            assert rs.locked_block.hash() == bid.hash
+            # our own precommit is for the locked block
+            pc = f.cs.votes.precommits(0).get_by_address(f.privs[0].address)
+            assert pc is not None and pc.block_id.hash == bid.hash
+            # drive to round 1 with nil precommits from others
+            nil = BlockID(b"", PartSetHeader.zero())
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, nil, [1, 2, 3])
+            deadline = time.time() + 10
+            while time.time() < deadline and f.cs.get_round_state().round < 1:
+                time.sleep(0.01)
+            # in round 1 we must have prevoted the LOCKED block again
+            deadline = time.time() + 10
+            pv = None
+            while time.time() < deadline:
+                pvs = f.cs.votes.prevotes(1)
+                pv = pvs.get_by_address(f.privs[0].address) if pvs else None
+                if pv is not None:
+                    break
+                time.sleep(0.01)
+            assert pv is not None, "no round-1 prevote from locked validator"
+            assert pv.block_id.hash == bid.hash
+        finally:
+            f.stop()
+
+    def test_unlock_on_nil_polka(self):
+        """A +2/3 nil-prevote polka in a later round releases the lock
+        (reference TestLockPOLUnlock essence)."""
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            bid = f.proposal_block_id()
+            f.inject_votes(VOTE_TYPE_PREVOTE, bid, [1, 2, 3])
+            f.wait_event(ev.EVENT_LOCK)
+            nil = BlockID(b"", PartSetHeader.zero())
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, nil, [1, 2, 3])
+            deadline = time.time() + 10
+            while time.time() < deadline and f.cs.get_round_state().round < 1:
+                time.sleep(0.01)
+            # round 1: others polka nil -> we must unlock and precommit nil
+            f.inject_votes(VOTE_TYPE_PREVOTE, nil, [1, 2, 3], round_=1)
+            f.wait_event(ev.EVENT_UNLOCK)
+            rs = f.cs.get_round_state()
+            assert rs.locked_block is None and rs.locked_round == -1
+        finally:
+            f.stop()
+
+
+class TestWALRecovery:
+    def test_wal_records_and_endheight(self, tmp_path):
+        wal_path = str(tmp_path / "cs.wal")
+        f = Fixture(n_vals=1, wal_path=wal_path)
+        try:
+            f.cs.start()
+            f.wait_height(2)
+        finally:
+            f.stop()
+        recs = list(WAL.iter_records(wal_path))
+        heights = [r.height for r in recs if isinstance(r, EndHeightMessage)]
+        assert 1 in heights and 2 in heights
+        votes = [r for r in recs if isinstance(r, MsgRecord) and isinstance(r.msg, Vote)]
+        assert votes, "own votes must be WAL'd"
+
+    def test_restart_resumes_from_wal_and_store(self, tmp_path):
+        wal_path = str(tmp_path / "cs.wal")
+        db, store_db = MemDB(), MemDB()
+        f = Fixture(n_vals=1, wal_path=wal_path, db=db, store_db=store_db)
+        try:
+            f.cs.start()
+            f.wait_height(2)
+        finally:
+            f.stop()
+        # restart on the same dbs + WAL; must pick up after last ENDHEIGHT
+        from tendermint_tpu.state import load_state
+
+        state = load_state(db)
+        h0 = state.last_block_height
+        f2 = Fixture.__new__(Fixture)
+        Fixture.__init__(f2, n_vals=1, wal_path=wal_path, db=db, store_db=store_db)
+        # __init__ created a fresh genesis state; rebuild cs from saved state
+        f2.stop()
+        conns = local_client_creator(KVStoreApp())()
+        # replay chain into the fresh app (handshake's job; done manually here)
+        from tendermint_tpu.state.execution import exec_commit_block
+
+        store = BlockStore(store_db)
+        for h in range(1, h0 + 1):
+            exec_commit_block(conns.consensus, store.load_block(h))
+        # real ticker: if the pre-crash node signed a proposal that never
+        # hit the WAL, the privval refuses to re-sign it (reference
+        # `types/priv_validator.go:249-251` — proposals include time and
+        # can be lost); the node then recovers via the round-1 timeout
+        # path, which needs real timeouts to fire.
+        cs2 = ConsensusState(
+            config=ConsensusConfig.test_config(),
+            state=state,
+            app_conn=conns.consensus,
+            block_store=store,
+            priv_validator=f.privs[0],
+            wal_path=wal_path,
+            ticker=TimeoutTicker(),
+        )
+        got = queue.Queue()
+        cs2.event_switch.add_listener(
+            "t", ev.EVENT_NEW_BLOCK, lambda d: got.put(d)
+        )
+        cs2.start()
+        try:
+            data = got.get(timeout=10)
+            assert data.block.header.height == h0 + 1
+        finally:
+            cs2.stop()

@@ -1,0 +1,176 @@
+"""Mempool behavior + BlockStore round-trips."""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
+from tendermint_tpu.abci.client import local_client_creator
+from tendermint_tpu.blockchain import BlockStore
+from tendermint_tpu.db.kv import MemDB
+from tendermint_tpu.mempool import Mempool, TxCache
+from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.types.tx import Txs
+
+from tests.helpers import ChainSim
+
+
+class TestTxCache:
+    def test_dedup_and_eviction(self):
+        c = TxCache(size=2)
+        assert c.push(b"a") and not c.push(b"a")
+        c.push(b"b")
+        c.push(b"c")  # evicts a
+        assert c.push(b"a")
+
+    def test_remove(self):
+        c = TxCache(size=4)
+        c.push(b"a")
+        c.remove(b"a")
+        assert c.push(b"a")
+
+
+def _mempool(app=None, **kw):
+    conns = local_client_creator(app or KVStoreApp())()
+    return Mempool(conns.mempool, **kw), conns
+
+
+class TestMempool:
+    def test_check_reap_update(self):
+        mp, _ = _mempool()
+        for i in range(5):
+            mp.check_tx(b"k%d=v%d" % (i, i))
+        assert mp.size() == 5
+        assert mp.check_tx(b"k0=v0").log == "tx already exists in cache"
+        assert mp.size() == 5
+        reaped = mp.reap(3)
+        assert len(reaped) == 3
+        assert len(mp.reap(-1)) == 5
+        mp.update(1, Txs([b"k0=v0", b"k1=v1"]))
+        assert mp.size() == 3
+
+    def test_bad_tx_rejected_and_uncached(self):
+        app = CounterApp(serial=True)
+        mp, conns = _mempool(app)
+        mp.check_tx((5).to_bytes(2, "big"))
+        assert mp.size() == 1
+        # nonce 0 < tx_count after deliver? deliver 6 txs via consensus conn
+        for i in range(6):
+            conns.consensus.deliver_tx_async(i.to_bytes(1, "big") if i else b"")
+        mp.check_tx((2).to_bytes(1, "big"))  # nonce 2 < 6: rejected
+        assert mp.size() == 1
+        # rejected tx was evicted from the cache, so it can be retried
+        assert mp.check_tx((2).to_bytes(1, "big")).code != 0
+
+    def test_update_recheck_drops_stale(self):
+        app = CounterApp(serial=True)
+        mp, conns = _mempool(app)
+        for i in range(3):
+            mp.check_tx(i.to_bytes(1, "big") if i else b"\x00")
+        assert mp.size() == 3
+        # app advances past nonce 1 -> txs 0,1 now stale
+        conns.consensus.deliver_tx_async(b"\x00")
+        conns.consensus.deliver_tx_async(b"\x01")
+        mp.update(1, Txs())
+        assert mp.size() == 1  # only nonce-2 tx survives recheck
+
+    def test_txs_available_fires_once_per_height(self):
+        mp, _ = _mempool()
+        fired = []
+        mp.set_on_txs_available(lambda: fired.append(1))
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        assert len(fired) == 1
+        mp.update(1, Txs([b"a=1"]))  # pool still has b=2 -> fires again
+        assert len(fired) == 2
+
+    def test_wal_replay(self, tmp_path):
+        mp, _ = _mempool(wal_dir=str(tmp_path))
+        mp.check_tx(b"x=1")
+        mp.check_tx(b"y=2")
+        assert mp.load_wal() == [b"x=1", b"y=2"]
+        mp.close()
+
+    def test_get_after_blocks_until_new_tx(self):
+        mp, _ = _mempool()
+        mp.check_tx(b"a=1")
+        got = mp.get_after(0)
+        assert got == [b"a=1"]
+        results = []
+
+        def waiter():
+            results.extend(mp.get_after(1, wait=True, timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        mp.check_tx(b"b=2")
+        t.join(timeout=5)
+        assert results == [b"b=2"]
+
+
+class TestBlockStore:
+    def _chain(self, n=3):
+        sim = ChainSim(n_vals=3)
+        store = BlockStore(MemDB())
+        for i in range(n):
+            block, ps = sim.make_next_block(txs=[b"t%d=%d" % (i, i)])
+            commit = sim._commit_for(block, ps)
+            from tendermint_tpu.state import apply_block
+
+            apply_block(sim.state, block, ps.header, sim.conns.consensus)
+            sim.blocks.append(block)
+            sim.commits.append(commit)
+            store.save_block(block, ps, commit)
+        return sim, store
+
+    def test_save_load_roundtrip(self):
+        sim, store = self._chain(3)
+        assert store.height == 3
+        for h in (1, 2, 3):
+            blk = store.load_block(h)
+            assert blk is not None and blk.hash() == sim.blocks[h - 1].hash()
+            meta = store.load_block_meta(h)
+            assert meta.header.height == h
+            assert meta.block_id.hash == blk.hash()
+        # canonical commit for h is carried by block h+1
+        c2 = store.load_block_commit(2)
+        assert c2.hash() == sim.blocks[2].last_commit.hash()
+        sc3 = store.load_seen_commit(3)
+        assert sc3.hash() == sim.commits[2].hash()
+        assert store.load_block(4) is None
+        assert store.load_block_commit(99) is None
+
+    def test_parts_individually_loadable(self):
+        sim, store = self._chain(1)
+        meta = store.load_block_meta(1)
+        total = meta.block_id.parts_header.total
+        buf = b""
+        for i in range(total):
+            part = store.load_block_part(1, i)
+            assert part is not None and part.index == i
+            buf += part.bytes_
+        from tendermint_tpu.types.block import Block
+
+        assert Block.decode(buf).hash() == sim.blocks[0].hash()
+        assert store.load_block_part(1, total) is None
+
+    def test_noncontiguous_save_rejected(self):
+        sim, store = self._chain(1)
+        block, ps = sim.make_next_block()
+        block.header.height = 5
+        with pytest.raises(ValidationError, match="contiguous"):
+            store.save_block(block, ps, sim.commits[-1])
+
+    def test_reload_watermark(self):
+        db = MemDB()
+        sim = ChainSim(n_vals=3)
+        store = BlockStore(db)
+        block, ps = sim.make_next_block()
+        commit = sim._commit_for(block, ps)
+        from tendermint_tpu.state import apply_block
+
+        apply_block(sim.state, block, ps.header, sim.conns.consensus)
+        store.save_block(block, ps, commit)
+        store2 = BlockStore(db)
+        assert store2.height == 1
+        assert store2.load_block(1).hash() == block.hash()

@@ -1,0 +1,201 @@
+"""Handshake replay matrix + full-node crash/recovery at every fail index.
+
+Reference `consensus/replay_test.go:296-317` (TestHandshakeReplay*) and
+`test/persist/test_failure_indices.sh` (kill at each fail point, restart,
+assert re-sync).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.client import local_client_creator
+from tendermint_tpu.blockchain import BlockStore
+from tendermint_tpu.consensus.replay import Handshaker, HandshakeError
+from tendermint_tpu.db.kv import MemDB
+from tendermint_tpu.state import apply_block, load_state, make_genesis_state
+
+from tests.helpers import ChainSim
+
+N = 4  # chain length for the matrix
+
+
+class _Chain:
+    """A recorded 4-block chain + state snapshots at N-1 and N."""
+
+    def __init__(self):
+        self.sim = ChainSim(n_vals=3)
+        self.store = BlockStore(MemDB())
+        self.parts = []
+        for i in range(N):
+            block, ps = self.sim.make_next_block(txs=[b"h%d=%d" % (i + 1, i)])
+            commit = self.sim._commit_for(block, ps)
+            if i == N - 1:
+                self.state_before_last = self.sim.state.copy()
+            apply_block(self.sim.state, block, ps.header, self.sim.conns.consensus)
+            self.store.save_block(block, ps, commit)
+            self.sim.blocks.append(block)
+            self.sim.commits.append(commit)
+        self.final_state = self.sim.state
+
+    def fresh_app_at(self, height: int):
+        """A new app replayed to `height` (its own independent instance)."""
+        app = KVStoreApp()
+        conns = local_client_creator(app)()
+        from tendermint_tpu.state.execution import exec_commit_block
+
+        for h in range(1, height + 1):
+            exec_commit_block(conns.consensus, self.store.load_block(h))
+        return app, conns
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return _Chain()
+
+
+class TestHandshakeMatrix:
+    def _handshake(self, chain, state, app_height):
+        app, conns = chain.fresh_app_at(app_height)
+        h = Handshaker(state, chain.store)
+        app_hash = h.handshake(conns)
+        return app, conns, h, app_hash
+
+    def test_replay_all(self, chain):
+        state = chain.final_state.copy()
+        app, conns, h, app_hash = self._handshake(chain, state, 0)
+        assert h.n_blocks_replayed == N
+        assert app_hash == state.app_hash
+        assert conns.query.info_sync().last_block_height == N
+
+    def test_replay_some(self, chain):
+        state = chain.final_state.copy()
+        app, conns, h, app_hash = self._handshake(chain, state, 2)
+        assert h.n_blocks_replayed == N - 2
+        assert app_hash == state.app_hash
+
+    def test_replay_none(self, chain):
+        state = chain.final_state.copy()
+        app, conns, h, app_hash = self._handshake(chain, state, N)
+        assert h.n_blocks_replayed == 0
+        assert app_hash == state.app_hash
+
+    def test_final_block_via_mock_app(self, chain):
+        """App committed block N but state didn't save: state catches up
+        from saved ABCIResponses without re-executing the real app."""
+        state = chain.state_before_last.copy()
+        state.db = chain.final_state.db  # responses live here
+        app, conns = chain.fresh_app_at(N)
+        before_txs = dict(app._data)
+        h = Handshaker(state, chain.store)
+        app_hash = h.handshake(conns)
+        assert state.last_block_height == N
+        assert app_hash == chain.final_state.app_hash
+        assert app._data == before_txs  # real app was not re-mutated
+
+    def test_final_block_via_real_replay(self, chain):
+        """State saved N-1, app also behind: final block replays for real."""
+        state = chain.state_before_last.copy()
+        state.db = MemDB()  # fresh db; apply_block will save into it
+        app, conns = chain.fresh_app_at(N - 1)
+        h = Handshaker(state, chain.store)
+        app_hash = h.handshake(conns)
+        assert state.last_block_height == N
+        assert app_hash == chain.final_state.app_hash
+        assert conns.query.info_sync().last_block_height == N
+
+    def test_store_ahead_of_state_by_two_rejected(self, chain):
+        state = chain.state_before_last.copy()
+        state.last_block_height = N - 3
+        app, conns = chain.fresh_app_at(0)
+        with pytest.raises(HandshakeError):
+            Handshaker(state, chain.store).handshake(conns)
+
+    def test_genesis_init_chain(self):
+        sim = ChainSim(n_vals=3)
+        store = BlockStore(MemDB())
+        app = KVStoreApp()
+        inited = []
+        app.init_chain = lambda validators: inited.append(len(validators))
+        conns = local_client_creator(app)()
+        Handshaker(sim.state, store).handshake(conns)
+        assert inited == [3]
+
+
+_CRASH_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time, queue
+    sys.path.insert(0, {repo!r})
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax; jax.config.update("jax_platforms", "cpu")
+    os.chdir({repo!r})
+    home = {home!r}
+    from tendermint_tpu.db.kv import SQLiteDB
+    from tendermint_tpu.abci.apps import PersistentKVStoreApp
+    from tendermint_tpu.abci.client import local_client_creator
+    from tendermint_tpu.blockchain import BlockStore
+    from tendermint_tpu.consensus import ConsensusConfig, ConsensusState, TimeoutTicker
+    from tendermint_tpu.consensus.replay import Handshaker
+    from tendermint_tpu.state import load_state, make_genesis_state
+    from tendermint_tpu.types import events as ev
+    from tests.helpers import make_genesis
+
+    state_db = SQLiteDB(home + "/state.db")
+    store = BlockStore(SQLiteDB(home + "/blockstore.db"))
+    app = PersistentKVStoreApp(SQLiteDB(home + "/app.db"))
+    conns = local_client_creator(app)()
+    gen, privs = make_genesis(1, chain_id="crash-chain")
+    state = load_state(state_db)
+    if state is None:
+        state = make_genesis_state(state_db, gen)
+        state.save()
+    state.db = state_db
+    Handshaker(state, store).handshake(conns)
+    cs = ConsensusState(
+        config=ConsensusConfig.test_config(), state=state,
+        app_conn=conns.consensus, block_store=store,
+        priv_validator=privs[0], wal_path=home + "/cs.wal",
+        ticker=TimeoutTicker(),
+    )
+    got = queue.Queue()
+    cs.event_switch.add_listener("t", ev.EVENT_NEW_BLOCK, lambda d: got.put(d))
+    cs.start()
+    start_h = state.last_block_height
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        data = got.get(timeout=30)
+        if data.block.header.height >= start_h + 2:
+            print("REACHED", data.block.header.height)
+            break
+    cs.stop()
+    """
+)
+
+
+class TestCrashRecoveryMatrix:
+    @pytest.mark.parametrize("fail_index", range(0, 7))
+    def test_kill_at_fail_point_then_recover(self, tmp_path, fail_index):
+        """Run a solo node that crashes at fail point `fail_index`, then
+        restart without injection and require progress (the reference's
+        test_failure_indices matrix)."""
+        home = str(tmp_path)
+        script = tmp_path / "node.py"
+        script.write_text(_CRASH_SCRIPT.format(repo=os.getcwd(), home=home))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", FAIL_TEST_INDEX=str(fail_index))
+        p1 = subprocess.run(
+            [sys.executable, str(script)], env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+        assert p1.returncode == 1, f"index {fail_index} did not crash: {p1.stdout}\n{p1.stderr}"
+        # restart clean: must handshake, recover, and commit 2 more blocks
+        env.pop("FAIL_TEST_INDEX")
+        p2 = subprocess.run(
+            [sys.executable, str(script)], env=env, capture_output=True, text=True,
+            timeout=120,
+        )
+        assert p2.returncode == 0, f"recovery failed at index {fail_index}:\n{p2.stdout}\n{p2.stderr}"
+        assert "REACHED" in p2.stdout
