@@ -13,6 +13,9 @@ class CodeType:
     ENCODING_ERROR = 2
     BAD_NONCE = 3
     UNAUTHORIZED = 4
+    # Node-level (non-app) rejection: duplicate tx already in the mempool
+    # cache (reference mempool.go:172-178 returns ErrTxInCache).
+    TX_IN_CACHE = 5
 
 
 @dataclass

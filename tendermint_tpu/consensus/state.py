@@ -41,7 +41,11 @@ from tendermint_tpu.state.state import State
 from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.block import Block, Commit
 from tendermint_tpu.types.block_id import BlockID
-from tendermint_tpu.types.errors import ErrDoubleSign, ValidationError
+from tendermint_tpu.types.errors import (
+    ErrDoubleSign,
+    FatalConsensusError,
+    ValidationError,
+)
 from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.services import NopMempool
@@ -85,6 +89,9 @@ class ConsensusState:
         self._mtx = threading.RLock()
         self._thread: threading.Thread | None = None
         self._running = False
+        # Set when an internal invariant/persistence failure halts the
+        # loop (the reference panics instead; see FatalConsensusError).
+        self.fatal_error: BaseException | None = None
 
         self.ticker = ticker if ticker is not None else TimeoutTicker()
         self.ticker.set_on_timeout(self._enqueue_timeout)
@@ -140,14 +147,20 @@ class ConsensusState:
             self.wal.close()
 
     def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        if self.fatal_error is not None:
+            return  # halted: nothing drains the queue anymore
         self._queue.put(MsgRecord(vote, peer_id))
 
     def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        if self.fatal_error is not None:
+            return
         self._queue.put(MsgRecord(proposal, peer_id))
 
     def add_proposal_block_part(
         self, height: int, round_: int, part: Part, peer_id: str = ""
     ) -> None:
+        if self.fatal_error is not None:
+            return
         self._queue.put(MsgRecord((height, round_, part), peer_id))
 
     def get_round_state(self) -> RoundState:
@@ -189,11 +202,23 @@ class ConsensusState:
                     # input — it is not WAL'd (matches the reference, where
                     # txsAvailable arrives on a separate non-WAL'd channel)
                     if self.wal is not None and not isinstance(item, _TxsAvailable):
-                        self.wal.save(item)
+                        try:
+                            self.wal.save(item)
+                        except Exception as e:
+                            raise FatalConsensusError("WAL write failed") from e
                     self._dispatch(item)
-            except ErrDoubleSign:
+            except (ErrDoubleSign, FatalConsensusError) as e:
+                # Internal failure: halt consensus rather than keep voting
+                # from a half-advanced state (reference PanicConsensus —
+                # crash recovery takes over on restart).
+                import traceback
+
+                traceback.print_exc()
+                self.fatal_error = e
+                self._running = False
+                self.ticker.stop()
                 raise
-            except Exception as e:  # a bad peer message must not kill consensus
+            except Exception:  # a bad peer message must not kill consensus
                 import traceback
 
                 traceback.print_exc()
@@ -300,8 +325,20 @@ class ConsensusState:
             for rec in records:
                 if isinstance(rec, (EndHeightMessage, RoundStateRecord)):
                     continue
-                with self._mtx:
-                    self._dispatch(rec)
+                try:
+                    with self._mtx:
+                        self._dispatch(rec)
+                except (ErrDoubleSign, FatalConsensusError):
+                    raise
+                except Exception:
+                    # Inputs are WAL'd BEFORE validation, so a bad peer
+                    # message (invalid sig, conflicting vote) can be on
+                    # disk; tolerate it here exactly like the live loop
+                    # does, or the node can never restart (reference
+                    # replay.go logs-and-continues the same way).
+                    import traceback
+
+                    traceback.print_exc()
         finally:
             self.wal = saved_wal
 
@@ -670,33 +707,47 @@ class ConsensusState:
         block_id = self.votes.precommits(self.commit_round).two_thirds_majority()
         assert block is not None and block.hash_to(block_id.hash)
 
-        fail_point()  # before block save
-        if self.block_store is not None and self.block_store.height < height:
-            seen_commit = self.votes.precommits(self.commit_round).make_commit()
-            self.block_store.save_block(block, parts, seen_commit)
+        # Any failure from here on is an internal invariant/persistence
+        # error, not bad peer input: once the block is saved / ENDHEIGHT is
+        # WAL'd, a swallowed exception would leave a live node half-advanced
+        # (store=H, WAL done, state=H-1) but still voting. Escalate so the
+        # receive loop halts and crash recovery handles it on restart
+        # (reference panics via PanicConsensus in finalizeCommit/ApplyBlock).
+        try:
+            fail_point()  # before block save
+            if self.block_store is not None and self.block_store.height < height:
+                seen_commit = self.votes.precommits(self.commit_round).make_commit()
+                self.block_store.save_block(block, parts, seen_commit)
 
-        fail_point()  # block saved, before WAL ENDHEIGHT
-        if self.wal is not None:
-            self.wal.save(EndHeightMessage(height))
+            fail_point()  # block saved, before WAL ENDHEIGHT
+            if self.wal is not None:
+                self.wal.save(EndHeightMessage(height))
 
-        fail_point()  # ENDHEIGHT written, before ApplyBlock
-        state_copy = self.state.copy()
-        apply_block(
-            state_copy,
-            block,
-            parts.header,
-            self.app_conn,
-            mempool=self.mempool,
-            verifier=self.verifier,
-        )
+            fail_point()  # ENDHEIGHT written, before ApplyBlock
+            state_copy = self.state.copy()
+            apply_block(
+                state_copy,
+                block,
+                parts.header,
+                self.app_conn,
+                mempool=self.mempool,
+                verifier=self.verifier,
+            )
 
+            fail_point()  # applied, before round-state reset
+            self._update_to_state(state_copy)
+        except FatalConsensusError:
+            raise
+        except Exception as e:
+            raise FatalConsensusError(
+                f"finalize_commit failed at height {height}"
+            ) from e
+        # Listener callbacks are external code — a raising subscriber must
+        # not be escalated to a consensus halt, so fire outside the scope.
         self.event_switch.fire(ev.EVENT_NEW_BLOCK, ev.EventDataNewBlock(block))
         self.event_switch.fire(
             ev.EVENT_NEW_BLOCK_HEADER, ev.EventDataNewBlockHeader(block.header)
         )
-
-        fail_point()  # applied, before round-state reset
-        self._update_to_state(state_copy)
         self._schedule_round0()
 
     # ---------------------------------------------------------------- votes

@@ -18,7 +18,7 @@ from dataclasses import dataclass
 from typing import Callable
 
 from tendermint_tpu.abci.client import AppConnMempool
-from tendermint_tpu.abci.types import Result
+from tendermint_tpu.abci.types import CodeType, Result
 from tendermint_tpu.types.tx import Tx, Txs
 
 DEFAULT_CACHE_SIZE = 100_000
@@ -110,7 +110,12 @@ class Mempool:
         """
         tx = bytes(tx)
         if not self._cache.push(tx):
-            res = Result(code=0, log="tx already exists in cache")
+            # Non-zero code so RPC/broadcast callers can distinguish an
+            # accepted tx from a silently-dropped duplicate (reference
+            # returns ErrTxInCache, mempool.go:172-178).
+            res = Result(
+                code=CodeType.TX_IN_CACHE, log="tx already exists in cache"
+            )
             if cb is not None:
                 cb(res)
             return res

@@ -519,7 +519,15 @@ def prepare_batch(pubkeys, msgs, sigs):
             continue
         s_int = int.from_bytes(sig[32:], "little")
         if s_int >= L:
-            continue  # RFC 8032: non-canonical S is invalid
+            # DELIBERATE STRICTNESS (divergence from the reference): we
+            # reject non-canonical S >= L per RFC 8032 §5.1.7. The
+            # reference's Go ed25519 only checks sig[63]&224 == 0, so it
+            # accepts malleable S in [L, 2^253). Strictness removes
+            # signature malleability; the cost is that reference-signed
+            # artifacts with non-canonical S (never produced by honest
+            # signers) fail here. Host fallback applies the same rule, so
+            # the framework is internally consistent.
+            continue
         precheck[i] = True
         pub[i] = np.frombuffer(pk, dtype=np.uint8)
         r[i] = np.frombuffer(sig[:32], dtype=np.uint8)

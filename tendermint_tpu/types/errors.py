@@ -51,3 +51,10 @@ class ErrVoteConflictingVotes(VoteError):
 class ErrDoubleSign(TMError):
     """PrivValidator refused to sign: height/round/step regression or
     conflicting sign-bytes (reference `types/priv_validator.go:225-275`)."""
+
+
+class FatalConsensusError(TMError):
+    """An internal invariant/persistence failure (failed block apply, WAL
+    write, app commit). Unlike bad peer input, this must HALT consensus —
+    the reference panics (PanicConsensus/PanicSanity) so crash recovery
+    takes over rather than voting from a half-advanced state."""

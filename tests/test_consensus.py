@@ -265,6 +265,62 @@ class TestWALRecovery:
         votes = [r for r in recs if isinstance(r, MsgRecord) and isinstance(r.msg, Vote)]
         assert votes, "own votes must be WAL'd"
 
+    def test_poisoned_wal_does_not_brick_restart(self, tmp_path):
+        """Inputs are WAL'd BEFORE validation, so an invalid peer vote can
+        be on disk; replay must tolerate it like the live loop does
+        (reference replay.go logs-and-continues) instead of raising out of
+        start() on every restart."""
+        wal_path = str(tmp_path / "cs.wal")
+        db, store_db = MemDB(), MemDB()
+        f = Fixture(n_vals=1, wal_path=wal_path, db=db, store_db=store_db)
+        try:
+            f.cs.start()
+            f.wait_height(2)
+        finally:
+            f.stop()
+        from tendermint_tpu.state import load_state
+
+        state = load_state(db)
+        h0 = state.last_block_height
+        # poison: garbage-signature vote for the in-progress height,
+        # appended as if a peer sent it just before the crash
+        bad = Vote(
+            validator_address=f.privs[0].address,
+            validator_index=0,
+            height=h0 + 1,
+            round=0,
+            timestamp=time.time_ns(),
+            type=VOTE_TYPE_PREVOTE,
+            block_id=BlockID(b"", PartSetHeader.zero()),
+            signature=b"\x01" * 64,
+        )
+        w = WAL(wal_path)
+        w.save(MsgRecord(bad, "badpeer"))
+        w.close()
+        conns = local_client_creator(KVStoreApp())()
+        from tendermint_tpu.state.execution import exec_commit_block
+
+        store = BlockStore(store_db)
+        for h in range(1, h0 + 1):
+            exec_commit_block(conns.consensus, store.load_block(h))
+        cs2 = ConsensusState(
+            config=ConsensusConfig.test_config(),
+            state=state,
+            app_conn=conns.consensus,
+            block_store=store,
+            priv_validator=f.privs[0],
+            wal_path=wal_path,
+            ticker=TimeoutTicker(),
+        )
+        got = queue.Queue()
+        cs2.event_switch.add_listener("t", ev.EVENT_NEW_BLOCK, lambda d: got.put(d))
+        cs2.start()  # must NOT raise on the poisoned record
+        try:
+            data = got.get(timeout=10)
+            assert data.block.header.height == h0 + 1
+        finally:
+            cs2.stop()
+
     def test_restart_resumes_from_wal_and_store(self, tmp_path):
         wal_path = str(tmp_path / "cs.wal")
         db, store_db = MemDB(), MemDB()

@@ -41,7 +41,9 @@ class TestMempool:
         for i in range(5):
             mp.check_tx(b"k%d=v%d" % (i, i))
         assert mp.size() == 5
-        assert mp.check_tx(b"k0=v0").log == "tx already exists in cache"
+        dup = mp.check_tx(b"k0=v0")
+        assert dup.log == "tx already exists in cache"
+        assert not dup.is_ok  # duplicates are a visible rejection (ErrTxInCache)
         assert mp.size() == 5
         reaped = mp.reap(3)
         assert len(reaped) == 3
