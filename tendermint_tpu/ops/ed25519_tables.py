@@ -1,0 +1,510 @@
+"""Table-driven batched ed25519 verification: the steady-state fast path.
+
+The round-1 kernel (`ed25519_kernel.verify_kernel`) runs a generic
+253-step Shamir ladder per signature (~4.8k field muls). But consensus,
+fast-sync and the light client verify commits signed by a KNOWN validator
+set that changes rarely (reference hot loops: `types/validator_set.go:
+236-261`, `types/vote_set.go:137-196`; SURVEY.md §7 hard part 4 calls for
+pre-staged validator-set device arrays cached by valset hash). This module
+exploits that:
+
+* **[S]B — fixed-base comb.** B is a compile-time constant: a w=8 table
+  (32 windows x 256 entries = 8192 precomputed points, ~2.6 MB) turns
+  [S]B into 32 mixed adds with zero doublings.
+* **[h]A — cached per-validator window tables.** A per-validator w=4
+  table (64 windows x 16 entries) is built ON DEVICE once per validator
+  set, then every verification of that validator is 64 mixed adds with
+  zero doublings and no point decompression.
+* **No R decompression.** Like the reference's verifier (Go ed25519
+  computes R' = [S]B - [h]A and byte-compares with sig[:32]), we encode
+  the computed point and compare bytes. Affine normalization uses a
+  log-depth batched tree inversion (~3 muls/signature amortized instead
+  of ~265 for a per-lane inversion).
+* Mixed additions use precomputed affine entries (y+x, y-x, 2d*x*y):
+  7 field muls each (madd-2008-hwcd-3, a=-1) vs 9 for the unified add.
+
+Net: ~0.7k field muls/signature vs ~4.8k for the generic ladder, with
+identical per-signature verdict semantics (bad signatures localize).
+
+Tables hold multiples of -A so the device accumulates
+[S]B + [h](-A) and checks its encoding equals sig[:32].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tendermint_tpu.ops.ed25519_kernel import (
+    BX,
+    BY,
+    D,
+    D2,
+    NLIMBS,
+    P,
+    _D2_L,
+    _ONE_L,
+    _int_to_limbs,
+    fe_canon,
+    fe_carry,
+    fe_invert,
+    fe_mul,
+    fe_sub,
+    fe_to_bytes,
+    pt_add,
+    pt_decompress,
+    pt_double,
+    pt_neg,
+)
+
+A_WINDOW = 4  # per-validator tables: 64 windows x 16 entries
+A_NWIN = 64
+B_NWIN = 32  # fixed-base table: 32 windows x 256 entries (w=8)
+
+
+# -- host EC over Python ints (B-table build + tests) -------------------------
+
+
+def _hadd(p, q):
+    """Extended twisted-Edwards add (a=-1), Python ints."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = t1 * D2 % P * t2 % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+_H_IDENT = (0, 1, 1, 0)
+_B_EXT = (BX, BY, 1, BX * BY % P)
+
+
+def host_scalar_mul(k: int, p) -> tuple[int, int, int, int]:
+    """[k]P by double-and-add over Python ints (tests / cross-checks)."""
+    acc = _H_IDENT
+    while k:
+        if k & 1:
+            acc = _hadd(acc, p)
+        p = _hadd(p, p)
+        k >>= 1
+    return acc
+
+
+def host_affine(p) -> tuple[int, int]:
+    x, y, z, _ = p
+    zi = pow(z, P - 2, P)
+    return (x * zi % P, y * zi % P)
+
+
+def _precomp_limbs(x: int, y: int) -> np.ndarray:
+    """Affine point -> (3, 20) int32 precomp form (y+x, y-x, 2d*x*y)."""
+    return np.stack(
+        [
+            _int_to_limbs((y + x) % P),
+            _int_to_limbs((y - x) % P),
+            _int_to_limbs(2 * D * x % P * y % P),
+        ]
+    )
+
+
+_B_TABLE: np.ndarray | None = None
+
+
+def b_table() -> np.ndarray:
+    """Fixed-base table: (B_NWIN*256, 3, 20) int32; entry [w*256+j] holds
+    j * 2^(8w) * B in affine precomp form. Built lazily once per process
+    (~8k host point adds + one Montgomery batched inversion)."""
+    global _B_TABLE
+    if _B_TABLE is not None:
+        return _B_TABLE
+    entries = []  # extended points, Python ints
+    base = _B_EXT
+    for _ in range(B_NWIN):
+        e = _H_IDENT
+        for _j in range(256):
+            entries.append(e)
+            e = _hadd(e, base)
+        for _ in range(8):
+            base = _hadd(base, base)
+    # batched affine normalization (Montgomery trick)
+    zs = [p[2] for p in entries]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % P)
+    inv = pow(prefix[-1], P - 2, P)
+    out = np.zeros((len(entries), 3, NLIMBS), dtype=np.int32)
+    for i in reversed(range(len(entries))):
+        zi = inv * prefix[i] % P
+        inv = inv * zs[i] % P
+        x, y = entries[i][0] * zi % P, entries[i][1] * zi % P
+        out[i] = _precomp_limbs(x, y)
+    _B_TABLE = out
+    return out
+
+
+# -- device primitives --------------------------------------------------------
+
+
+def pt_madd(acc, entry):
+    """Mixed add: extended acc + affine precomp entry (ypx, ymx, t2d).
+
+    madd-2008-hwcd-3 with a=-1 and Z2=1: 7 muls. Entry limbs are
+    canonical (< 2^13), acc limbs loose — both satisfy fe_mul's bound.
+    """
+    x1, y1, z1, t1 = acc
+    ypx, ymx, t2d = entry
+    a = fe_mul(fe_sub(y1, x1), ymx)
+    b = fe_mul(fe_carry(y1 + x1), ypx)
+    c = fe_mul(t1, t2d)
+    d = fe_carry(z1 + z1)
+    e = fe_sub(b, a)
+    f = fe_sub(d, c)
+    g = fe_carry(d + c)
+    h = fe_carry(b + a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def fe_batch_invert(z):
+    """Invert every row of z (M, 20), M a power of two, via a log-depth
+    product tree: ~3 muls per element + ONE fe_invert total (vs ~265
+    muls per element for per-lane inversion). Zero inputs are the
+    caller's responsibility (Z of a valid point is never 0)."""
+    levels = []
+    cur = z
+    while cur.shape[0] > 1:
+        levels.append(cur)
+        cur = fe_mul(cur[0::2], cur[1::2])
+    inv = fe_invert(cur)
+    for lev in reversed(levels):
+        left, right = lev[0::2], lev[1::2]
+        inv_left = fe_mul(inv, right)
+        inv_right = fe_mul(inv, left)
+        inv = jnp.stack([inv_left, inv_right], axis=1).reshape(lev.shape)
+    return inv
+
+
+def _identity_like(ref):
+    """Extended identity (0,1,1,0) built FROM an input array so the scan
+    carry is device-varying under shard_map (same trick as the generic
+    kernel's ladder)."""
+    vzero = (ref[..., :1] * 0).astype(jnp.int32)
+    zero = vzero + jnp.zeros(NLIMBS, dtype=jnp.int32)
+    one = vzero + jnp.asarray(_ONE_L)
+    return (zero, one, one, zero)
+
+
+# encoding of the identity point (y=1, x=0): decompresses cleanly, used
+# as padding so padded build lanes stay on-curve
+_IDENT_PUB = np.zeros((1, 32), dtype=np.uint8)
+_IDENT_PUB[0, 0] = 1
+
+
+# -- table build (device) -----------------------------------------------------
+
+
+@jax.jit
+def _build_tables_kernel(pub_bytes):
+    """(N, 32) uint8 pubkeys -> ((1024, N, 60) int32 tables, (N,) ok).
+
+    Window-major layout: row (window*16 + digit) column n holds
+    digit * 2^(4*window) * (-A_n) in affine precomp form (ypx|ymx|t2d
+    flattened to 60 limbs). Window-major keeps per-window slices on the
+    MAJOR axis so the gather-free selection pass never forces a padded
+    transpose of the whole table (minor dims of 16 tile to 128 and would
+    8x the table's footprint). N must be a power of two (callers pad) so
+    the entry count feeds the inversion tree exactly.
+    """
+    a_pt, ok = pt_decompress(pub_bytes)
+    w0 = pt_neg(a_pt)  # tables hold multiples of -A
+
+    def outer(w, _):
+        def add_step(e, _x):
+            e2 = pt_add(e, w)
+            return e2, e2
+
+        ident = _identity_like(w[0])
+        _, steps = lax.scan(add_step, ident, None, length=15)
+        # entries: identity + the 15 partial sums -> (16, N, 20) per coord
+        entries = tuple(
+            jnp.concatenate([iv[None], st], axis=0)
+            for iv, st in zip(ident, steps)
+        )
+        nxt = w
+        for _i in range(A_WINDOW):
+            nxt = pt_double(nxt)
+        return nxt, entries
+
+    _, ent = lax.scan(outer, w0, None, length=A_NWIN)
+    # ent: 4 arrays of (64, 16, N, 20) -> flatten the entry dimension
+    ex, ey, ez, _et = (e.reshape(-1, NLIMBS) for e in ent)
+    zinv = fe_batch_invert(fe_carry(ez))
+    ax = fe_mul(ex, zinv)
+    ay = fe_mul(ey, zinv)
+    ypx = fe_canon(fe_carry(ay + ax))
+    ymx = fe_canon(fe_sub(ay, ax))
+    t2d = fe_canon(fe_mul(fe_mul(ax, ay), jnp.asarray(_D2_L)))
+    n = pub_bytes.shape[0]
+    # (64*16*N, 20) each, in (window, digit, val) order -> (1024, N, 60)
+    tbl = jnp.stack([ypx, ymx, t2d], axis=-2).reshape(
+        A_NWIN * 16, n, 3 * NLIMBS
+    )
+    return tbl, ok
+
+
+def build_key_tables(pub_bytes: np.ndarray, chunk: int = 2048):
+    """Build per-validator window tables on device, chunked to bound peak
+    memory (each chunk materializes chunk*1024 extended points).
+
+    pub_bytes: (N, 32) uint8. Returns (tables (1024, N, 60) int32 on
+    device, ok (N,) bool on host)."""
+    n = pub_bytes.shape[0]
+    tbls, oks = [], []
+    for lo in range(0, n, chunk):
+        part = np.asarray(pub_bytes[lo : lo + chunk], dtype=np.uint8)
+        m = part.shape[0]
+        padded = 1
+        while padded < m:
+            padded *= 2
+        if padded != m:
+            part = np.concatenate(
+                [part, np.tile(_IDENT_PUB, (padded - m, 1))], axis=0
+            )
+        t, ok = _build_tables_kernel(jnp.asarray(part))
+        tbls.append(t[:, :m])
+        oks.append(np.asarray(ok)[:m])
+    return jnp.concatenate(tbls, axis=1), np.concatenate(oks)
+
+
+# -- verification (device) ----------------------------------------------------
+#
+# TPU gathers are slow (measured ~60x the cost of the arithmetic they
+# feed), so table entries are selected WITHOUT gathers: one-hot f32
+# matmuls ride the MXU (table limbs < 2^13 and one-hot rows have a single
+# nonzero, so f32 accumulation is exact). The 96 sequential mixed adds
+# then run either as an XLA scan (portable; CPU tests) or as a Pallas
+# kernel that keeps the accumulator in VMEM across all steps (TPU fast
+# path — XLA's scan materializes the carry through HBM every step).
+
+NSTEPS = B_NWIN + A_NWIN  # 96 mixed adds per signature
+
+
+def _select_entries(a_tables, s, h):
+    """Gather-free operand selection -> (NSTEPS, B, 60) int32.
+
+    a_tables: (1024, N, 60) window-major; lane b uses table column
+    (b mod N), so one validator set verifies K stacked commits with
+    B = K*N lanes. Selection is 16 fused mask-multiplies per window —
+    the whole table streams through the VPU exactly once (a true gather
+    would be ~60x slower on TPU, measured).
+    """
+    bsz = s.shape[0]
+    n_vals = a_tables.shape[1]
+    reps = bsz // n_vals
+    btab = jnp.asarray(b_table()).reshape(B_NWIN, 256, 60).astype(jnp.float32)
+    outs = []
+    for w in range(B_NWIN):
+        oh = (s[:, w : w + 1] == jnp.arange(256)[None, :]).astype(jnp.float32)
+        outs.append(
+            jnp.dot(oh, btab[w], preferred_element_type=jnp.float32).astype(
+                jnp.int32
+            )
+        )
+    for w in range(A_NWIN):
+        byte = h[:, w // 2]
+        digit = (byte >> (4 * (w % 2))) & 0xF
+        acc = None
+        for d in range(16):
+            twd = a_tables[w * 16 + d]  # (N, 60), major-axis slice
+            if reps != 1:
+                twd = jnp.broadcast_to(twd[None], (reps, n_vals, 60)).reshape(
+                    bsz, 60
+                )
+            term = jnp.where((digit == d)[:, None], twd, 0)
+            acc = term if acc is None else acc + term
+        outs.append(acc)
+    return jnp.stack(outs, axis=0)
+
+
+def _sum_entries_xla(ent):
+    """Portable scan over the NSTEPS mixed adds; ent (NSTEPS, B, 60)."""
+    acc = _identity_like(ent[0, :, :1])
+
+    def step(a, e):
+        e3 = e.reshape(e.shape[0], 3, NLIMBS)
+        return pt_madd(a, (e3[:, 0], e3[:, 1], e3[:, 2])), None
+
+    acc, _ = lax.scan(step, acc, ent)
+    return acc
+
+
+# ---- pallas fast path -------------------------------------------------------
+#
+# Layout: the batch is tiled into (8, 128) VPU tiles; every field-element
+# limb is a separate (8, 128) plane so each vector op runs at full lane
+# occupancy. The accumulator lives in a VMEM scratch (80 planes = X,Y,Z,T
+# x 20 limbs) that persists across the NSTEPS minor grid steps; entry
+# planes stream in as (60, 8, 128) blocks double-buffered by the Pallas
+# pipeline. HBM traffic is therefore one read of the entries and one
+# write of the final accumulator — the XLA scan's per-step carry
+# round-trips are gone.
+
+_LANES = 1024  # 8 x 128 batch elements per grid tile
+
+
+def _carry_planes(t):
+    """fe_carry on a list of 20 (8,128) planes (3 rounds, like fe_carry)."""
+    for _ in range(3):
+        c = [v >> 13 for v in t]
+        r = [v & 8191 for v in t]
+        t = [r[0] + 608 * c[-1]] + [r[i] + c[i - 1] for i in range(1, 20)]
+    return t
+
+
+def _mul_planes(a, b):
+    """fe_mul on lists of 20 (8,128) planes (mirrors fe_mul exactly)."""
+    cols = []
+    for k in range(39):
+        lo, hi = max(0, k - 19), min(k, 19)
+        t = a[lo] * b[k - lo]
+        for i in range(lo + 1, hi + 1):
+            t = t + a[i] * b[k - i]
+        cols.append(t)
+    c = [v >> 13 for v in cols]
+    r = [v & 8191 for v in cols]
+    out = [r[0]] + [r[i] + c[i - 1] for i in range(1, 39)]
+    lo_ = out[:20]
+    hi_ = out[20:] + [c[-1]]
+    return _carry_planes([lo_[i] + 608 * hi_[i] for i in range(20)])
+
+
+def _sub_planes(a, b):
+    d = [x - y for x, y in zip(a, b)]
+    return _carry_planes(_carry_planes(d))
+
+
+def _addc_planes(a, b):
+    return _carry_planes([x + y for x, y in zip(a, b)])
+
+
+def _madd_planes(acc, ypx, ymx, t2d):
+    x1, y1, z1, t1 = acc
+    a = _mul_planes(_sub_planes(y1, x1), ymx)
+    b = _mul_planes(_addc_planes(y1, x1), ypx)
+    c = _mul_planes(t1, t2d)
+    d = _carry_planes([v + v for v in z1])
+    e = _sub_planes(b, a)
+    f = _sub_planes(d, c)
+    g = _addc_planes(d, c)
+    h = _addc_planes(b, a)
+    return (
+        _mul_planes(e, f),
+        _mul_planes(g, h),
+        _mul_planes(f, g),
+        _mul_planes(e, h),
+    )
+
+
+def _madd_chain_kernel(ent_ref, out_ref, acc_ref):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        # identity (0, 1, 1, 0): Y limb 0 and Z limb 0 are 1 (scatter is
+        # not lowerable in pallas, so build via an iota select)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (80, 8, 128), 0)
+        acc_ref[:] = jnp.where((rows == 20) | (rows == 40), 1, 0)
+
+    ent = ent_ref[0, 0]  # (60, 8, 128)
+    acc = tuple(
+        [acc_ref[20 * ci + i] for i in range(20)] for ci in range(4)
+    )
+    ypx = [ent[i] for i in range(20)]
+    ymx = [ent[20 + i] for i in range(20)]
+    t2d = [ent[40 + i] for i in range(20)]
+    nxt = _madd_planes(acc, ypx, ymx, t2d)
+    acc_ref[:] = jnp.stack([p for coord in nxt for p in coord])
+
+    @pl.when(t == NSTEPS - 1)
+    def _():
+        out_ref[0] = acc_ref[:]
+
+
+def _sum_entries_pallas(ent):
+    """ent (NSTEPS, B, 60) -> extended acc, B a multiple of 1024 lanes."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bsz = ent.shape[1]
+    tiles = bsz // _LANES
+    # (NSTEPS, B, 60) -> (tiles, NSTEPS, 60, 8, 128)
+    e = ent.reshape(NSTEPS, tiles, 8, 128, 60)
+    e = jnp.transpose(e, (1, 0, 4, 2, 3))
+    out = pl.pallas_call(
+        _madd_chain_kernel,
+        grid=(tiles, NSTEPS),
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, 60, 8, 128),
+                lambda i, t: (i, t, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 80, 8, 128), lambda i, t: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((tiles, 80, 8, 128), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((80, 8, 128), jnp.int32)],
+    )(e)
+    # (tiles, 80, 8, 128) -> 4 coords of (B, 20)
+    coords = out.reshape(tiles, 4, 20, 8, 128)
+    coords = jnp.transpose(coords, (1, 0, 3, 4, 2)).reshape(4, bsz, NLIMBS)
+    return coords[0], coords[1], coords[2], coords[3]
+
+
+from functools import partial  # noqa: E402
+
+
+@partial(jax.jit, static_argnames=("impl",))
+def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
+    """Batched verify against cached tables.
+
+    a_tables: (1024, N, 60) int32 from build_key_tables (window-major).
+    s_bytes:  (B, 32) uint8, S little-endian (host-checked < L).
+    h_bytes:  (B, 32) uint8, SHA512(R||A||M) mod L little-endian.
+    r_bytes:  (B, 32) uint8, the signature's R encoding (sig[:32]).
+
+    Lane b verifies against validator row (b mod N) — one commit is
+    B == N lanes in validator order; fast-sync stacks K commits of the
+    same valset as B = K*N. Returns (B,) bool:
+    encode([S]B + [h](-A)) == r_bytes, the same cofactorless
+    byte-compare the reference's ed25519 performs. B must be a multiple
+    of N and (for the pallas path) of 1024; callers pad and mask.
+    """
+    s = s_bytes.astype(jnp.int32)
+    h = h_bytes.astype(jnp.int32)
+    r = r_bytes.astype(jnp.int32)
+
+    ent = _select_entries(a_tables, s, h)
+    use_pallas = impl == "pallas" or (
+        impl == "auto"
+        and jax.default_backend() == "tpu"
+        and s.shape[0] % _LANES == 0
+    )
+    if use_pallas:
+        x, y, z, _t = _sum_entries_pallas(ent)
+    else:
+        x, y, z, _t = _sum_entries_xla(ent)
+
+    zinv = fe_batch_invert(fe_carry(z))
+    x_aff = fe_canon(fe_mul(x, zinv))
+    y_bytes = fe_to_bytes(fe_mul(y, zinv))
+    parity = x_aff[..., 0] & 1
+    sign = (r[..., 31] >> 7) & 1
+    r_clean = r.at[..., 31].set(r[..., 31] & 0x7F)
+    return jnp.all(y_bytes == r_clean, axis=-1) & (parity == sign)
