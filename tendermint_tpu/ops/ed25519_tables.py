@@ -169,10 +169,20 @@ def pt_madd(acc, entry):
 
 
 def fe_batch_invert(z):
-    """Invert every row of z (M, 20), M a power of two, via a log-depth
-    product tree: ~3 muls per element + ONE fe_invert total (vs ~265
-    muls per element for per-lane inversion). Zero inputs are the
-    caller's responsibility (Z of a valid point is never 0)."""
+    """Invert every row of z (M, 20) via a log-depth product tree:
+    ~3 muls per element + ONE fe_invert total (vs ~265 muls per element
+    for per-lane inversion). Non-power-of-two M is padded with ones (a
+    fixed point of inversion) so the tree halves evenly. Zero inputs are
+    the caller's responsibility (Z of a valid point is never 0)."""
+    m = z.shape[0]
+    padded = 1
+    while padded < m:
+        padded *= 2
+    if padded != m:
+        ones = jnp.broadcast_to(
+            jnp.asarray(_ONE_L), (padded - m, NLIMBS)
+        ).astype(z.dtype)
+        z = jnp.concatenate([z, ones], axis=0)
     levels = []
     cur = z
     while cur.shape[0] > 1:
@@ -184,7 +194,7 @@ def fe_batch_invert(z):
         inv_left = fe_mul(inv, right)
         inv_right = fe_mul(inv, left)
         inv = jnp.stack([inv_left, inv_right], axis=1).reshape(lev.shape)
-    return inv
+    return inv[:m]
 
 
 def _identity_like(ref):
@@ -308,10 +318,18 @@ def _select_entries(a_tables, s, h):
     outs = []
     for w in range(B_NWIN):
         oh = (s[:, w : w + 1] == jnp.arange(256)[None, :]).astype(jnp.float32)
+        # precision=HIGHEST: TPU matmuls default to bf16 operand passes,
+        # which truncates 13-bit table limbs and corrupts every entry
+        # (one-hot selection needs the full f32 mantissa, which HIGHEST's
+        # multi-pass f32 guarantees; accumulation of a single nonzero
+        # term is then exact).
         outs.append(
-            jnp.dot(oh, btab[w], preferred_element_type=jnp.float32).astype(
-                jnp.int32
-            )
+            jnp.dot(
+                oh,
+                btab[w],
+                preferred_element_type=jnp.float32,
+                precision=lax.Precision.HIGHEST,
+            ).astype(jnp.int32)
         )
     for w in range(A_NWIN):
         byte = h[:, w // 2]
@@ -484,20 +502,31 @@ def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
     same valset as B = K*N. Returns (B,) bool:
     encode([S]B + [h](-A)) == r_bytes, the same cofactorless
     byte-compare the reference's ed25519 performs. B must be a multiple
-    of N and (for the pallas path) of 1024; callers pad and mask.
+    of N; the pallas path pads lanes to its 1024-lane tiles internally.
     """
     s = s_bytes.astype(jnp.int32)
     h = h_bytes.astype(jnp.int32)
     r = r_bytes.astype(jnp.int32)
+    bsz = s.shape[0]
 
     ent = _select_entries(a_tables, s, h)
     use_pallas = impl == "pallas" or (
-        impl == "auto"
-        and jax.default_backend() == "tpu"
-        and s.shape[0] % _LANES == 0
+        impl == "auto" and jax.default_backend() == "tpu"
     )
     if use_pallas:
+        if bsz % _LANES != 0:
+            # pad lanes with the identity precomp entry (ypx=1, ymx=1,
+            # t2d=0 — the affine point (0,1)); madd with it keeps the
+            # accumulator on the same projective point with Z != 0, so
+            # padded lanes are harmless through the batched inversion.
+            pad = _LANES - bsz % _LANES
+            ident = jnp.zeros((NSTEPS, pad, 3, NLIMBS), dtype=jnp.int32)
+            ident = ident.at[:, :, 0, 0].set(1).at[:, :, 1, 0].set(1)
+            ent = jnp.concatenate(
+                [ent, ident.reshape(NSTEPS, pad, 3 * NLIMBS)], axis=1
+            )
         x, y, z, _t = _sum_entries_pallas(ent)
+        x, y, z = x[:bsz], y[:bsz], z[:bsz]
     else:
         x, y, z, _t = _sum_entries_xla(ent)
 
@@ -508,3 +537,52 @@ def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
     sign = (r[..., 31] >> 7) & 1
     r_clean = r.at[..., 31].set(r[..., 31] & 0x7F)
     return jnp.all(y_bytes == r_clean, axis=-1) & (parity == sign)
+
+
+# -- host-side lane prep ------------------------------------------------------
+
+
+def prepare_commit_lanes(pubkeys, commits):
+    """Host prep for K stacked commits over one N-validator set.
+
+    pubkeys: N 32-byte pubkey encodings in validator order.
+    commits: K pairs (msgs, sigs) — each a length-N sequence aligned to
+    validator index, with None marking absent votes.
+
+    Returns (s, h, r) uint8 arrays of shape (K*N, 32) and a (K*N,) bool
+    precheck mask (False for absent lanes and host-detected malformed
+    signatures: wrong length or non-canonical S >= L, the same strict-S
+    rule as `ed25519_kernel.prepare_batch`). Lane k*N+i aligns with
+    `verify_tables_kernel`'s b-mod-N column mapping.
+    """
+    import hashlib
+
+    from tendermint_tpu.ops.ed25519_kernel import L as _L
+
+    n = len(pubkeys)
+    k = len(commits)
+    s = np.zeros((k * n, 32), dtype=np.uint8)
+    h = np.zeros((k * n, 32), dtype=np.uint8)
+    r = np.zeros((k * n, 32), dtype=np.uint8)
+    precheck = np.zeros(k * n, dtype=bool)
+    for ci, (msgs, sigs) in enumerate(commits):
+        if len(msgs) != n or len(sigs) != n:
+            raise ValueError(f"commit {ci}: expected {n} lanes")
+        for i in range(n):
+            msg, sig = msgs[i], sigs[i]
+            if msg is None or sig is None:
+                continue
+            if len(sig) != 64 or len(pubkeys[i]) != 32:
+                continue
+            if int.from_bytes(sig[32:], "little") >= _L:
+                continue
+            lane = ci * n + i
+            precheck[lane] = True
+            r[lane] = np.frombuffer(sig[:32], dtype=np.uint8)
+            s[lane] = np.frombuffer(sig[32:], dtype=np.uint8)
+            hh = hashlib.sha512(sig[:32] + pubkeys[i] + msg).digest()
+            h[lane] = np.frombuffer(
+                (int.from_bytes(hh, "little") % _L).to_bytes(32, "little"),
+                dtype=np.uint8,
+            )
+    return s, h, r, precheck

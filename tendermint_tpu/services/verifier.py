@@ -85,6 +85,83 @@ class DeviceBatchVerifier(BatchVerifier):
         return batch_verify(list(pubs), list(msgs), list(sigs))
 
 
+class TableBatchVerifier(DeviceBatchVerifier):
+    """Valset-table-cached backend: the steady-state consensus fast path.
+
+    Commit-shaped verification (lanes aligned to a known validator set)
+    routes through per-validator comb tables (`ops.ed25519_tables`) built
+    ON DEVICE once per validator set and cached by the hash of its pubkey
+    sequence — SURVEY.md §7 hard part 4's pre-staged valset arrays. A
+    cached verify costs ~0.7k field muls/signature vs ~4.8k for the
+    generic ladder `DeviceBatchVerifier` falls back to for ad-hoc
+    triples (proposal sigs, mixed-key batches).
+    """
+
+    def __init__(self, cache_size: int = 4) -> None:
+        super().__init__()
+        from collections import OrderedDict
+
+        self._tables: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._cache_size = cache_size
+
+    def _tables_for(self, pubkeys: tuple[bytes, ...]):
+        import hashlib
+
+        key = hashlib.sha256(b"".join(pubkeys)).digest()
+        hit = self._tables.get(key)
+        if hit is not None:
+            self._tables.move_to_end(key)
+            return hit
+        from tendermint_tpu.ops.ed25519_tables import build_key_tables
+
+        pub = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(
+            len(pubkeys), 32
+        )
+        tables, ok = build_key_tables(pub)
+        self._tables[key] = (tables, ok)
+        while len(self._tables) > self._cache_size:
+            self._tables.popitem(last=False)
+        return tables, ok
+
+    def verify_commits(
+        self,
+        pubkeys: Sequence[bytes],
+        commits: Sequence[tuple[Sequence[bytes | None], Sequence[bytes | None]]],
+    ) -> np.ndarray:
+        """K commits over one N-validator set -> (K, N) bool verdicts.
+
+        Each commit is (msgs, sigs): length-N sequences aligned to
+        validator index, None marking absent votes (absent lanes report
+        False — callers already track presence). Replaces the
+        reference's per-commit sequential loop
+        (`types/validator_set.go:236-261`) with one K*N-lane device
+        batch against cached tables; fast-sync stacks many commits of
+        the same valset into a single call (BASELINE config 3).
+        """
+        from tendermint_tpu.ops.ed25519_tables import (
+            prepare_commit_lanes,
+            verify_tables_kernel,
+        )
+
+        n = len(pubkeys)
+        k = len(commits)
+        if n == 0 or k == 0:
+            return np.zeros((k, n), dtype=bool)
+        # malformed pubkeys degrade to a False verdict (matching every
+        # other backend) instead of corrupting the packed table build
+        length_ok = np.array([len(pk) == 32 for pk in pubkeys], dtype=bool)
+        if not length_ok.all():
+            placeholder = b"\x01" + b"\x00" * 31  # identity point encoding
+            pubkeys = [
+                pk if ok else placeholder for pk, ok in zip(pubkeys, length_ok)
+            ]
+        tables, key_ok = self._tables_for(tuple(pubkeys))
+        key_ok = key_ok & length_ok
+        s, h, r, precheck = prepare_commit_lanes(pubkeys, commits)
+        out = np.asarray(verify_tables_kernel(tables, s, h, r))
+        return (out & precheck & np.tile(key_ok, k)).reshape(k, n)
+
+
 _DEFAULT: BatchVerifier | None = None
 
 
@@ -103,7 +180,7 @@ def default_verifier() -> BatchVerifier:
         if jax.default_backend() == "cpu":
             _DEFAULT = HostBatchVerifier()
         else:
-            _DEFAULT = DeviceBatchVerifier()
+            _DEFAULT = TableBatchVerifier()
     return _DEFAULT
 
 

@@ -102,7 +102,19 @@ class EventSwitch:
         with self._lock:
             cbs = [cb for _, cb in self._listeners.get(event, [])]
         for cb in cbs:
-            cb(data)
+            # Listener callbacks are external code: a raising subscriber
+            # must never propagate into the firing component (the
+            # consensus loop fires NewBlock between commit and
+            # _schedule_round0 — an escaping exception there would stall
+            # the node at the new height).
+            try:
+                cb(data)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "event listener raised for %s", event
+                )
 
 
 class EventCache:

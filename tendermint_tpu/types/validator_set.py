@@ -54,6 +54,7 @@ class ValidatorSet:
         self.validators: list[Validator] = sorted(validators, key=lambda v: v.address)
         self._total = sum(v.voting_power for v in self.validators)
         self._proposer: Validator | None = None
+        self._addr_index: dict[bytes, int] | None = None
 
     # -- basic accessors ---------------------------------------------------
 
@@ -68,15 +69,18 @@ class ValidatorSet:
         return self._total
 
     def get_by_address(self, address: bytes) -> tuple[int, Validator | None]:
-        # validators are sorted by address — binary search (reference uses
-        # sort.Search; a linear scan would make commit verification O(n^2)).
-        import bisect
-
-        addrs = [v.address for v in self.validators]
-        i = bisect.bisect_left(addrs, address)
-        if i < len(addrs) and addrs[i] == address:
-            return i, self.validators[i]
-        return -1, None
+        # amortized O(1): the address->index map is built once per
+        # membership change (the reference's sort.Search is O(log n) per
+        # call; per-precommit lookups in verify_commit_any make anything
+        # worse than this quadratic at 10k validators)
+        if self._addr_index is None:
+            self._addr_index = {
+                v.address: i for i, v in enumerate(self.validators)
+            }
+        i = self._addr_index.get(address, -1)
+        if i < 0:
+            return -1, None
+        return i, self.validators[i]
 
     def get_by_index(self, index: int) -> Validator | None:
         if 0 <= index < len(self.validators):
@@ -136,15 +140,20 @@ class ValidatorSet:
                 if existing is None:
                     raise ValidationError("removing unknown validator")
                 self.validators.pop(idx)
+                # positions shifted: drop the cached address index so the
+                # next lookup in this same batch rebuilds it
+                self._addr_index = None
             elif existing is None:
                 self.validators.append(replace(c, accum=0))
-                # keep sorted so get_by_address (bisect) stays correct for
-                # any further change in this same batch
+                # keep sorted so index order stays canonical for any
+                # further change in this same batch
                 self.validators.sort(key=lambda v: v.address)
+                self._addr_index = None
             else:
                 self.validators[idx] = replace(existing, voting_power=c.voting_power)
         self._total = sum(v.voting_power for v in self.validators)
         self._proposer = None
+        self._addr_index = None
 
     # -- commit verification (the hot loop) ---------------------------------
 
@@ -185,10 +194,28 @@ class ValidatorSet:
 
         Reference `VerifyCommit types/validator_set.go:225-269` — but instead
         of one ed25519 verify per iteration, all signatures flush as a single
-        device batch when a `BatchVerifier` is supplied.
+        device batch when a `BatchVerifier` is supplied. Verifiers exposing
+        `verify_commits` (the valset-table cache) get the commit in
+        validator-lane order so repeated commits of one valset hit cached
+        per-validator comb tables.
         """
         triples, indices = self._collect_commit_sigs(chain_id, block_id, height, commit)
-        ok_mask = _verify_triples(triples, verifier)
+        if verifier is None:
+            from tendermint_tpu.services.verifier import default_verifier
+
+            verifier = default_verifier()
+        if triples and hasattr(verifier, "verify_commits"):
+            n = len(self.validators)
+            msgs: list[bytes | None] = [None] * n
+            sigs: list[bytes | None] = [None] * n
+            for (pk, msg, sig), idx in zip(triples, indices):
+                msgs[idx], sigs[idx] = msg, sig
+            grid = verifier.verify_commits(
+                [v.pub_key.data for v in self.validators], [(msgs, sigs)]
+            )
+            ok_mask = [bool(grid[0][i]) for i in indices]
+        else:
+            ok_mask = _verify_triples(triples, verifier)
         tallied = 0
         for ok, idx in zip(ok_mask, indices):
             precommit = commit.precommits[idx]

@@ -142,6 +142,23 @@ class TestSoloValidator:
         finally:
             f.stop()
 
+    def test_raising_listener_does_not_stall_consensus(self):
+        # EventSwitch.fire must isolate listener exceptions: a raising
+        # NewBlock subscriber fires between commit and _schedule_round0,
+        # and an escaping exception there would stall the node at the
+        # new height (round-2 advisor finding).
+        f = Fixture(n_vals=1, real_ticker=True)
+
+        def bomb(_data):
+            raise RuntimeError("subscriber bug")
+
+        f.cs.event_switch.add_listener("bomb", ev.EVENT_NEW_BLOCK, bomb)
+        try:
+            f.cs.start()
+            f.wait_height(3)  # keeps committing despite the raising listener
+        finally:
+            f.stop()
+
     def test_app_state_follows(self):
         f = Fixture(n_vals=1)
         try:
