@@ -135,21 +135,38 @@ def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
     }
 
 
-def _bench_merkle(n_leaves: int, leaf_bytes: int = 64) -> dict:
-    from tendermint_tpu.ops.merkle_kernel import merkle_root_device
+def _bench_merkle(n_leaves: int, leaf_bytes: int = 64, stack: int = 16) -> dict:
+    """Single 65k-leaf root (latency) + a `stack`-tree forest in one
+    device launch (throughput — BASELINE config 4's batched shape)."""
+    from tendermint_tpu.merkle.simple import simple_hash_from_byte_slices
+    from tendermint_tpu.ops.merkle_kernel import merkle_root_device, merkle_roots_forest
 
     items = [bytes([i % 256]) * leaf_bytes for i in range(n_leaves)]
     t0 = time.time()
-    merkle_root_device(items)
+    root = merkle_root_device(items)
     compile_s = time.time() - t0
+    assert root == simple_hash_from_byte_slices(items), "device root != host root"
     t0 = time.time()
     merkle_root_device(items)
     warm = time.time() - t0
+
+    forest = [items] * stack
+    t0 = time.time()
+    roots = merkle_roots_forest(forest)
+    forest_compile_s = time.time() - t0
+    assert all(r == root for r in roots)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.time()
+        merkle_roots_forest(forest)
+        best = min(best, time.time() - t0)
     return {
         "n_leaves": n_leaves,
-        "compile_s": round(compile_s, 2),
+        "compile_s": round(compile_s + forest_compile_s, 2),
         "warm_s": warm,
-        "leaves_per_s": n_leaves / warm,
+        "stack": stack,
+        "forest_warm_s": best,
+        "leaves_per_s": stack * n_leaves / best,
     }
 
 

@@ -59,21 +59,32 @@ def inner_hash_device(L, R):
     return sha256_fixed2_from_words(b0, b1)
 
 
+def _forest_levels(nodes, cnt, levels: int):
+    """Shared level reduction: nodes (T, P, 8) u32, cnt (T,) i32 valid leaf
+    prefixes, P = 2**levels. Returns (T, 8) root words. A pair exists only
+    if its right child is inside the valid prefix; an unpaired trailing
+    node is promoted (== left child unchanged)."""
+    t = nodes.shape[0]
+    for _ in range(levels):
+        left = nodes[:, 0::2]
+        right = nodes[:, 1::2]
+        half = left.shape[1]
+        paired = inner_hash_device(
+            left.reshape(t * half, 8), right.reshape(t * half, 8)
+        ).reshape(t, half, 8)
+        idx = jnp.arange(half, dtype=jnp.int32)
+        nodes = jnp.where(
+            (2 * idx[None, :] + 1 < cnt[:, None])[..., None], paired, left
+        )
+        cnt = (cnt + 1) // 2
+    return nodes[:, 0]
+
+
 @partial(jax.jit, static_argnames=("levels",))
 def _tree_reduce(leaves, count, levels: int):
     """leaves: (P, 8) u32 with P = 2**levels; count: traced i32 valid prefix.
-    Returns (8,) root words."""
-    nodes = leaves
-    for _ in range(levels):
-        left = nodes[0::2]
-        right = nodes[1::2]
-        paired = inner_hash_device(left, right)
-        idx = jnp.arange(left.shape[0], dtype=jnp.int32)
-        # pair exists only if its right child is inside the valid prefix;
-        # an unpaired trailing node is promoted (== left child unchanged).
-        nodes = jnp.where((2 * idx + 1 < count)[:, None], paired, left)
-        count = (count + 1) // 2
-    return nodes[0]
+    Returns (8,) root words. The T=1 case of `_forest_levels`."""
+    return _forest_levels(leaves[None], jnp.asarray(count)[None], levels)[0]
 
 
 def merkle_root_from_leaf_words(leaf_digests, count=None):
@@ -100,17 +111,77 @@ def merkle_root_from_leaf_words(leaf_digests, count=None):
     return _tree_reduce(leaf_digests, jnp.asarray(count, dtype=jnp.int32), levels)
 
 
+@partial(jax.jit, static_argnames=("max_blocks", "levels"))
+def _leafhash_and_reduce(blocks, n_blocks, counts, max_blocks: int, levels: int):
+    """Fused leaf hashing + forest reduction: ONE device launch.
+
+    blocks:   (T, P, max_blocks, 16) u32 padded leaf messages, P = 2**levels
+    n_blocks: (T, P) i32 per-leaf block counts (0 for pad rows)
+    counts:   (T,) i32 valid leaf prefix per tree
+    -> (T, 8) u32 root words.
+
+    One launch matters: every executable execution through the axon
+    tunnel costs ~86 ms wall-clock regardless of size (measured), so the
+    leaf SHA-256 pass and all log2(P) tree levels must ship as a single
+    executable rather than one call per stage.
+    """
+    from tendermint_tpu.ops.sha256_kernel import _sha256_masked
+
+    t, p = blocks.shape[0], blocks.shape[1]
+    flat = blocks.reshape(t * p, max_blocks, 16)
+    digs = _sha256_masked(flat, n_blocks.reshape(-1), max_blocks)
+    return _forest_levels(digs.reshape(t, p, 8), counts, levels)
+
+
+def merkle_roots_forest(trees: list[list[bytes]]) -> list[bytes]:
+    """Batched device tree build: one root per item list, ONE device call.
+
+    All trees pad to a common (P, max_blocks) shape — the fast-sync /
+    mempool-flood shape (BASELINE config 4: batched Txs.Hash + PartSet
+    roots) where many blocks' trees build concurrently. Bit-equal to
+    `merkle.simple.simple_hash_from_byte_slices` (sha256 algo) per tree.
+    """
+    from tendermint_tpu.ops.padding import (
+        bucket_blocks,
+        digests_to_bytes_be,
+        pad_sha256_prefixed,
+    )
+
+    t = len(trees)
+    if t == 0:
+        return []
+    counts = np.array([len(items) for items in trees], dtype=np.int32)
+    if (counts == 0).any():
+        raise ValueError("empty tree in forest (host root of [] is b'')")
+    n_max = int(counts.max())
+    p = 1
+    while p < n_max:
+        p *= 2
+    levels = p.bit_length() - 1
+    flat = [x for items in trees for x in items]
+    blocks, n_blocks = pad_sha256_prefixed(flat, LEAF_PREFIX)
+    mb = blocks.shape[1]
+    # bucket the forest size so varying tree counts reuse compiled shapes
+    # (pad trees are all-masked rows; their garbage roots are sliced off)
+    t_pad = bucket_blocks(t)
+    all_blocks = np.zeros((t_pad, p, mb, 16), dtype=np.uint32)
+    all_nblocks = np.zeros((t_pad, p), dtype=np.int32)
+    all_counts = np.ones(t_pad, dtype=np.int32)
+    all_counts[:t] = counts
+    off = 0
+    for i, c in enumerate(counts):
+        all_blocks[i, :c] = blocks[off : off + c]
+        all_nblocks[i, :c] = n_blocks[off : off + c]
+        off += c
+    roots = _leafhash_and_reduce(all_blocks, all_nblocks, all_counts, mb, levels)
+    return digests_to_bytes_be(np.asarray(roots)[:t])
+
+
 def merkle_root_device(items: list[bytes]) -> bytes:
     """Host convenience: full device tree build over raw byte items.
 
     Bit-equal to `merkle.simple.simple_hash_from_byte_slices` (sha256 algo).
     """
-    from tendermint_tpu.ops.padding import digests_to_bytes_be, pad_sha256
-    from tendermint_tpu.ops.sha256_kernel import sha256_batch_jax
-
     if not items:
         return b""
-    blocks, counts = pad_sha256([LEAF_PREFIX + x for x in items])
-    leaf_digests = sha256_batch_jax(blocks, counts)
-    root = merkle_root_from_leaf_words(leaf_digests)
-    return digests_to_bytes_be(np.asarray(root)[None, :])[0]
+    return merkle_roots_forest([items])[0]

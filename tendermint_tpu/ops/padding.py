@@ -40,14 +40,60 @@ def _md_pad(msg: bytes, block: int, length_bytes: int, length_le: bool) -> bytes
 
 def pad_sha256(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:
     """-> (blocks[B, max_blocks, 16] u32 big-endian words, n_blocks[B] i32)."""
-    padded = [_md_pad(m, 64, 8, length_le=False) for m in msgs]
-    counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
-    mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max(initial=1)))
-    out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)
-    for i, p in enumerate(padded):
-        words = np.frombuffer(p, dtype=">u4").astype(np.uint32)
-        out[i, : counts[i]] = words.reshape(-1, 16)
-    return out, counts
+    return pad_sha256_prefixed(msgs, b"", max_blocks)
+
+
+def pad_sha256_prefixed(
+    msgs: list[bytes], prefix: bytes = b"", max_blocks: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized SHA-256 padding of `prefix || msg` for every message.
+
+    -> (blocks[B, max_blocks, 16] u32 big-endian words, n_blocks[B] i32).
+
+    Bulk numpy packing grouped by message length: per-item Python work is
+    one len() only, so 65k+ Merkle leaves pack as a handful of C-speed
+    array writes instead of 65k bytes concatenations (the round-2 ingest
+    bottleneck at `merkle_kernel.py:113`).
+    """
+    n = len(msgs)
+    plen = len(prefix)
+    if n == 0:
+        return np.zeros((0, max_blocks or 1, 16), dtype=np.uint32), np.zeros(
+            0, dtype=np.int32
+        )
+    lens = np.fromiter((len(m) for m in msgs), dtype=np.int64, count=n) + plen
+    # Merkle-Damgård: msg || 0x80 || zeros || 8-byte bit length
+    counts = ((lens + 9 + 63) // 64).astype(np.int32)
+    mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max()))
+    buf = np.zeros((n, mb * 64), dtype=np.uint8)
+    prefix_arr = (
+        np.frombuffer(prefix, dtype=np.uint8) if plen else None
+    )
+    # group by length in one O(n log n) pass (per-unique-length rescans
+    # would be quadratic for mostly-distinct lengths)
+    order = np.argsort(lens, kind="stable")
+    sorted_lens = lens[order]
+    run_starts = np.nonzero(np.diff(sorted_lens))[0] + 1
+    for idx in np.split(order, run_starts):
+        total = int(lens[idx[0]])
+        body = total - plen
+        if body:
+            raw = np.frombuffer(
+                b"".join(msgs[i] for i in idx), dtype=np.uint8
+            ).reshape(len(idx), body)
+            buf[idx, plen:total] = raw
+        if plen:
+            buf[idx, :plen] = prefix_arr
+        buf[idx, total] = 0x80
+        c = int((total + 9 + 63) // 64)
+        length_be = np.frombuffer(
+            int(total * 8).to_bytes(8, "big"), dtype=np.uint8
+        )
+        buf[idx, c * 64 - 8 : c * 64] = length_be
+    blocks = (
+        buf.view(">u4").astype(np.uint32).reshape(n, mb, 16)
+    )
+    return blocks, counts
 
 
 def pad_sha512(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:

@@ -85,3 +85,17 @@ def test_merkle_empty():
 def test_merkle_device_large_pow2():
     items = [i.to_bytes(8, "big") for i in range(1024)]
     assert merkle_root_device(items) == simple_hash_from_byte_slices(items)
+
+
+def test_merkle_forest_mixed_tree_sizes():
+    # one launch, trees of different leaf counts and leaf lengths
+    from tendermint_tpu.ops.merkle_kernel import merkle_roots_forest
+
+    trees = [
+        [b"a", b"bb", b"ccc"],
+        [f"x{i}".encode() * (i % 3 + 1) for i in range(17)],
+        [b"solo"],
+        [i.to_bytes(4, "big") for i in range(64)],
+    ]
+    got = merkle_roots_forest(trees)
+    assert got == [simple_hash_from_byte_slices(t) for t in trees]
