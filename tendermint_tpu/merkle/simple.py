@@ -1,14 +1,28 @@
 """SimpleMerkle tree with inclusion proofs (host reference implementation).
 
-Tree shape matches the reference's SimpleTree (`docs/specification/
-merkle.rst:52-90`): leaves split at the largest power of two strictly less
-than n, recursing left/right. Unlike the reference (which hashes raw
-concatenation of wire-encoded children), we domain-separate leaf and inner
-nodes (RFC 6962 style: leaf = H(0x00||data), inner = H(0x01||L||R)) which
-closes second-preimage attacks between leaves and inner nodes.
+DELIBERATE DEVIATIONS from the reference's SimpleTree
+(`docs/specification/merkle.rst:52-90`), both chosen TPU-first:
 
-The TPU tree kernel (`ops/merkle_kernel.py`) implements the identical
-hashing rule so device and host roots are bit-equal.
+* **Split rule.** The reference splits leaves in half ("both sides of
+  the tree the same size, but the left side may be one greater" — its
+  6-leaf diagram splits 3/3). We split at the **largest power of two
+  strictly less than n** (the RFC 6962 / Certificate Transparency
+  rule). The two rules produce different shapes from 5 leaves up
+  (reference 5 -> 3/2; ours 5 -> 4/2). Ours is exactly equivalent to
+  bottom-up adjacent pairing with promotion of an unpaired trailing
+  node, which is what the device kernel vectorizes as log2(N) batched
+  levels; the reference's ceil-split tree has no such level-parallel
+  form.
+* **Domain separation.** The reference hashes raw concatenation of
+  wire-encoded children; we prefix leaf = H(0x00||data) and inner =
+  H(0x01||L||R) (RFC 6962 style), closing leaf/inner second-preimage
+  attacks.
+
+Roots are therefore NOT bit-compatible with reference roots (the
+domain separation alone guarantees that); within this framework, host
+(`merkle.simple`) and device (`ops/merkle_kernel.py`) trees implement
+the identical rule and are bit-equal — asserted by tests and by
+`bench.py`'s device-vs-host root check.
 """
 
 from __future__ import annotations
@@ -30,7 +44,9 @@ def inner_hash(left: bytes, right: bytes, algo: str = DEFAULT_ALGO) -> bytes:
 
 
 def _split_point(n: int) -> int:
-    """Largest power of two strictly less than n (reference tree split rule)."""
+    """Largest power of two strictly less than n (RFC 6962 split rule —
+    a deliberate deviation from the reference's ceil(n/2) split; see the
+    module docstring)."""
     if n < 2:
         raise ValueError("split requires n >= 2")
     k = 1

@@ -1,7 +1,9 @@
 """Device Merkle tree reduction (log-depth, batched SHA-256 inner nodes).
 
-Computes the same root as `tendermint_tpu.merkle.simple` (largest-power-of-two
-split rule) via an equivalent level-by-level pairing: at each level adjacent
+Computes the same root as `tendermint_tpu.merkle.simple` (RFC 6962
+largest-power-of-two split rule — the documented deviation from the
+reference's ceil-split; see `merkle/simple.py` module docstring) via an
+equivalent level-by-level pairing: at each level adjacent
 nodes pair into an inner hash and an unpaired trailing node is promoted
 unchanged. Each level is one batched 2-block SHA-256 over all pairs — the
 whole tree is log2(N) kernel steps (reference hot spots: `types/block.go:177`,

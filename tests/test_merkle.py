@@ -26,8 +26,9 @@ def test_two_leaves_structure():
     assert simple_hash_from_byte_slices([b"a", b"b"]) == inner_hash(l0, l1)
 
 
-def test_split_rule_matches_reference_shape():
-    # 5 leaves: split at 4 (largest power of two < 5)
+def test_split_rule_rfc6962_shape():
+    # 5 leaves: split at 4 (largest power of two < 5 — the RFC 6962 rule,
+    # a documented deviation from the reference's 3/2 ceil-split)
     items = [bytes([i]) for i in range(5)]
     lh = [leaf_hash(x) for x in items]
     left = simple_hash_from_hashes(lh[:4])
