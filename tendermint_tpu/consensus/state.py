@@ -847,8 +847,13 @@ class ConsensusState:
             vote = self.priv_validator.sign_vote(self.state.chain_id, vote)
         except ErrDoubleSign:
             return
-        # handle immediately (we're already on the consensus thread);
-        # WAL it like any other input
-        if self.wal is not None:
-            self.wal.save(MsgRecord(vote, ""))
-        self._handle_vote(vote, "")
+        # Enqueue our own vote instead of handling it inline (reference
+        # `sendInternalMessage :1471-1487`): a synchronous _handle_vote
+        # here re-enters the transition functions — _enter_precommit can
+        # finalize the height mid-call, after which the CALLER's
+        # still-running transition (e.g. _on_precommit_added's
+        # `_enter_commit(self.height, ...)`) reads the NEW height's
+        # round state and corrupts it (observed as a fatal "enterCommit
+        # without +2/3 precommits" under multi-node gossip load). The
+        # queue item is WAL'd by the receive loop like any other input.
+        self._queue.put(MsgRecord(vote, ""))

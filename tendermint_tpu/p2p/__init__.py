@@ -1,0 +1,34 @@
+"""P2P communication backend (reference `p2p/`).
+
+Host-side control plane: multiplexed prioritized channels over pluggable
+byte transports (in-memory pipes for tests and local multi-node,
+TCP-ready framing), a Reactor registry Switch, and network fault
+injection. WAN gossip is latency-bound tiny-payload work — the wrong
+shape for TPU interconnect — so this layer stays on host by design
+(SURVEY.md §5.8); the TPU data plane lives in `parallel/` (ICI
+collectives) and `ops/` (batch kernels).
+"""
+
+from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.peer import NodeInfo, Peer
+from tendermint_tpu.p2p.switch import (
+    Reactor,
+    Switch,
+    connect_switches,
+    make_connected_switches,
+)
+from tendermint_tpu.p2p.transport import FuzzedEndpoint, FuzzConfig, pipe_pair
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnection",
+    "NodeInfo",
+    "Peer",
+    "Reactor",
+    "Switch",
+    "connect_switches",
+    "make_connected_switches",
+    "FuzzedEndpoint",
+    "FuzzConfig",
+    "pipe_pair",
+]

@@ -1,0 +1,174 @@
+"""Multiplexed prioritized channels over one endpoint.
+
+The reference's MConnection (`p2p/connection.go:66-114`) carries N
+logical channels with byte IDs and priorities over one TCP conn, with
+send-queue backpressure and flow limits. Same design here over the
+`transport.Endpoint` seam: a send thread drains per-channel queues
+weighted by priority; a recv thread parses frames and hands
+(chan_id, payload) to the owner's on_receive callback.
+
+Frame format (TCP-ready, though the in-memory pipe preserves framing
+anyway): uvarint chan_id || uvarint len || payload.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
+
+
+@dataclass(frozen=True)
+class ChannelDescriptor:
+    """Reference `p2p/connection.go` ChannelDescriptor."""
+
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 64
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor) -> None:
+        self.desc = desc
+        self.queue: "queue.Queue[bytes]" = queue.Queue(
+            maxsize=desc.send_queue_capacity
+        )
+        self.recently_sent = 0
+
+
+class MConnection:
+    """One peer link: channel-multiplexed, priority-scheduled sends.
+
+    on_receive(chan_id, payload) runs on the recv thread; on_error(exc)
+    fires once when either side dies (the Switch uses it to drop the
+    peer — reference `stopForError p2p/connection.go:212-219`).
+    """
+
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        channels: list[ChannelDescriptor],
+        on_receive,
+        on_error=None,
+    ) -> None:
+        self._endpoint = endpoint
+        self._channels: dict[int, _Channel] = {
+            d.id: _Channel(d) for d in channels
+        }
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self._send_wake = threading.Event()
+        self._running = False
+        self._threads: list[threading.Thread] = []
+        self._err_once = threading.Event()
+
+    def start(self) -> None:
+        self._running = True
+        for fn, name in ((self._send_loop, "send"), (self._recv_loop, "recv")):
+            t = threading.Thread(target=fn, name=f"mconn-{name}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        self._endpoint.close()
+        self._send_wake.set()
+
+    # -- sending -----------------------------------------------------------
+
+    def send(self, chan_id: int, payload: bytes, timeout: float = 5.0) -> bool:
+        """Queue for send; blocks up to timeout on a full channel queue
+        (reference `Send` blocks, `TrySend` doesn't)."""
+        ch = self._channels.get(chan_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {chan_id:#x}")
+        if not self._running:
+            return False
+        try:
+            ch.queue.put(payload, timeout=timeout)
+        except queue.Full:
+            return False
+        self._send_wake.set()
+        return True
+
+    def try_send(self, chan_id: int, payload: bytes) -> bool:
+        ch = self._channels.get(chan_id)
+        if ch is None:
+            raise ValueError(f"unknown channel {chan_id:#x}")
+        if not self._running:
+            return False
+        try:
+            ch.queue.put_nowait(payload)
+        except queue.Full:
+            return False
+        self._send_wake.set()
+        return True
+
+    def _pick_channel(self) -> _Channel | None:
+        """Least-recently-sent-relative-to-priority scheduling (the
+        reference's sendSomePacketMsgs weighting)."""
+        best: _Channel | None = None
+        best_ratio = float("inf")
+        for ch in self._channels.values():
+            if ch.queue.empty():
+                continue
+            ratio = ch.recently_sent / max(ch.desc.priority, 1)
+            if ratio < best_ratio:
+                best_ratio = ratio
+                best = ch
+        return best
+
+    def _send_loop(self) -> None:
+        try:
+            while self._running:
+                ch = self._pick_channel()
+                if ch is None:
+                    # decay counters while idle so one burst doesn't
+                    # starve a channel forever
+                    for c in self._channels.values():
+                        c.recently_sent //= 2
+                    self._send_wake.wait(timeout=0.05)
+                    self._send_wake.clear()
+                    continue
+                try:
+                    payload = ch.queue.get_nowait()
+                except queue.Empty:
+                    continue
+                frame = (
+                    Writer().uvarint(ch.desc.id).bytes(payload).build()
+                )
+                self._endpoint.send(frame)
+                ch.recently_sent += len(payload)
+        except EndpointClosed:
+            self._die(None)
+        except Exception as e:  # transport failure kills the peer
+            self._die(e)
+
+    # -- receiving ---------------------------------------------------------
+
+    def _recv_loop(self) -> None:
+        try:
+            while self._running:
+                frame = self._endpoint.recv()
+                r = Reader(frame)
+                chan_id = r.uvarint()
+                payload = r.bytes()
+                if chan_id not in self._channels:
+                    continue  # unknown channel: drop (fuzz/future-proof)
+                self._on_receive(chan_id, payload)
+        except EndpointClosed:
+            self._die(None)
+        except Exception as e:
+            self._die(e)
+
+    def _die(self, exc: Exception | None) -> None:
+        if self._err_once.is_set():
+            return
+        self._err_once.set()
+        self._running = False
+        self._endpoint.close()
+        if self._on_error is not None:
+            self._on_error(exc)

@@ -1,0 +1,110 @@
+"""Peer: one remote node = NodeInfo + multiplexed connection.
+
+Reference `p2p/peer.go:17` — here the secret-connection handshake is a
+transport concern (the in-memory transport is already authenticated by
+construction); NodeInfo exchange happens at connect time through the
+Switch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
+from tendermint_tpu.p2p.transport import Endpoint
+
+
+@dataclass(frozen=True)
+class NodeInfo:
+    """Reference `p2p/peer.go` NodeInfo (identity + compat handshake)."""
+
+    node_id: str  # hex of the node key address
+    moniker: str
+    chain_id: str
+    version: str = "0.1.0"
+    channels: tuple[int, ...] = ()
+
+    def encode(self) -> bytes:
+        w = (
+            Writer()
+            .string(self.node_id)
+            .string(self.moniker)
+            .string(self.chain_id)
+            .string(self.version)
+        )
+        w.uvarint(len(self.channels))
+        for c in self.channels:
+            w.uvarint(c)
+        return w.build()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        r = Reader(data)
+        node_id, moniker, chain_id, version = (
+            r.string(),
+            r.string(),
+            r.string(),
+            r.string(),
+        )
+        channels = tuple(r.uvarint() for _ in range(r.uvarint()))
+        return cls(node_id, moniker, chain_id, version, channels)
+
+    def compatible_with(self, other: "NodeInfo") -> str | None:
+        """None if compatible, else the reason (reference
+        `NodeInfo.CompatibleWith`)."""
+        if self.chain_id != other.chain_id:
+            return f"chain_id mismatch: {self.chain_id} != {other.chain_id}"
+        if self.node_id == other.node_id:
+            return "self-connection"
+        return None
+
+
+class Peer:
+    """A connected peer: send/receive by channel + a KV store that
+    reactors use for per-peer state (reference peer.Set/Get, used for
+    `PeerState`)."""
+
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        endpoint: Endpoint,
+        channels: list[ChannelDescriptor],
+        on_receive,
+        on_error,
+        outbound: bool,
+    ) -> None:
+        self.node_info = node_info
+        self.outbound = outbound
+        self.data: dict[str, object] = {}  # reactor KV (PeerState lives here)
+        self._conn = MConnection(
+            endpoint,
+            channels,
+            lambda ch, payload: on_receive(ch, self, payload),
+            lambda exc: on_error(self, exc),
+        )
+
+    @property
+    def id(self) -> str:
+        return self.node_info.node_id
+
+    def start(self) -> None:
+        self._conn.start()
+
+    def stop(self) -> None:
+        self._conn.stop()
+
+    def send(self, chan_id: int, payload: bytes) -> bool:
+        return self._conn.send(chan_id, payload)
+
+    def try_send(self, chan_id: int, payload: bytes) -> bool:
+        return self._conn.try_send(chan_id, payload)
+
+    def get(self, key: str, default=None):
+        return self.data.get(key, default)
+
+    def set(self, key: str, value) -> None:
+        self.data[key] = value
+
+    def __repr__(self) -> str:
+        return f"Peer({self.node_info.moniker}:{self.id[:8]})"

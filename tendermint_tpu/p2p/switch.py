@@ -1,0 +1,193 @@
+"""Switch: reactor registry + peer lifecycle + channel dispatch.
+
+Reference `p2p/switch.go:20-28,60-76,106-121`. Reactors register
+channel descriptors; incoming frames dispatch to the reactor owning the
+channel. `make_connected_switches` / `connect_switches` wire N switches
+over in-memory pipes — the reference's net.Pipe test harness
+(`p2p/switch.go:502-534`) promoted to the primary local transport.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import NodeInfo, Peer
+from tendermint_tpu.p2p.transport import Endpoint, pipe_pair
+
+
+class Reactor:
+    """Plugin seam (reference `p2p/switch.go:20-28`)."""
+
+    def __init__(self) -> None:
+        self.switch: "Switch | None" = None
+
+    def set_switch(self, switch: "Switch") -> None:
+        self.switch = switch
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        raise NotImplementedError
+
+    def add_peer(self, peer: Peer) -> None:  # noqa: B027 — optional hook
+        pass
+
+    def remove_peer(self, peer: Peer, reason) -> None:  # noqa: B027
+        pass
+
+    def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
+        raise NotImplementedError
+
+    def on_start(self) -> None:  # noqa: B027
+        pass
+
+    def on_stop(self) -> None:  # noqa: B027
+        pass
+
+
+class Switch:
+    def __init__(self, node_info: NodeInfo) -> None:
+        self._base_info = node_info
+        self._reactors: dict[str, Reactor] = {}
+        self._chan_to_reactor: dict[int, Reactor] = {}
+        self._descriptors: list[ChannelDescriptor] = []
+        self._peers: dict[str, Peer] = {}
+        self._mtx = threading.RLock()
+        self._running = False
+
+    @property
+    def node_info(self) -> NodeInfo:
+        # advertise the registered channels
+        return NodeInfo(
+            node_id=self._base_info.node_id,
+            moniker=self._base_info.moniker,
+            chain_id=self._base_info.chain_id,
+            version=self._base_info.version,
+            channels=tuple(d.id for d in self._descriptors),
+        )
+
+    # -- reactors ----------------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        """Register + claim channels (reference `AddReactor :106-121`)."""
+        for d in reactor.get_channels():
+            if d.id in self._chan_to_reactor:
+                raise ValueError(f"channel {d.id:#x} already claimed")
+            self._chan_to_reactor[d.id] = reactor
+            self._descriptors.append(d)
+        self._reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Reactor:
+        return self._reactors[name]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        for r in self._reactors.values():
+            r.on_start()
+
+    def stop(self) -> None:
+        self._running = False
+        with self._mtx:
+            peers = list(self._peers.values())
+        for p in peers:
+            self.stop_peer(p, "switch stopping")
+        for r in self._reactors.values():
+            r.on_stop()
+
+    # -- peers -------------------------------------------------------------
+
+    def peers(self) -> list[Peer]:
+        with self._mtx:
+            return list(self._peers.values())
+
+    def n_peers(self) -> int:
+        with self._mtx:
+            return len(self._peers)
+
+    def add_peer_endpoint(
+        self, remote_info: NodeInfo, endpoint: Endpoint, outbound: bool
+    ) -> Peer:
+        """Attach a connected endpoint as a peer (version/chain/dup checks
+        per reference `addPeer :216-260`)."""
+        reason = self.node_info.compatible_with(remote_info)
+        if reason is not None:
+            endpoint.close()
+            raise ValueError(f"incompatible peer: {reason}")
+        with self._mtx:
+            if remote_info.node_id in self._peers:
+                endpoint.close()
+                raise ValueError(f"duplicate peer {remote_info.node_id}")
+            peer = Peer(
+                remote_info,
+                endpoint,
+                self._descriptors,
+                self._dispatch,
+                self._on_peer_error,
+                outbound,
+            )
+            self._peers[remote_info.node_id] = peer
+        peer.start()
+        for r in self._reactors.values():
+            r.add_peer(peer)
+        return peer
+
+    def stop_peer(self, peer: Peer, reason) -> None:
+        with self._mtx:
+            if self._peers.get(peer.id) is not peer:
+                return
+            del self._peers[peer.id]
+        peer.stop()
+        for r in self._reactors.values():
+            r.remove_peer(peer, reason)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """Reference `StopPeerForError` — reactors call this on bad
+        messages; the peer is dropped everywhere."""
+        self.stop_peer(peer, reason)
+
+    def _on_peer_error(self, peer: Peer, exc) -> None:
+        self.stop_peer(peer, exc)
+
+    def _dispatch(self, chan_id: int, peer: Peer, payload: bytes) -> None:
+        reactor = self._chan_to_reactor.get(chan_id)
+        if reactor is None:
+            return
+        try:
+            reactor.receive(chan_id, peer, payload)
+        except Exception as e:
+            # a reactor exploding on a message is peer-fault by default
+            self.stop_peer_for_error(peer, e)
+
+    # -- broadcast ---------------------------------------------------------
+
+    def broadcast(self, chan_id: int, payload: bytes) -> None:
+        for p in self.peers():
+            p.try_send(chan_id, payload)
+
+
+def connect_switches(a: Switch, b: Switch) -> tuple[Peer, Peer]:
+    """Wire two switches over an in-memory pipe (reference
+    `Connect2Switches p2p/switch.go:526-534`)."""
+    ea, eb = pipe_pair()
+    pa = a.add_peer_endpoint(b.node_info, ea, outbound=True)
+    pb = b.add_peer_endpoint(a.node_info, eb, outbound=False)
+    return pa, pb
+
+
+def make_connected_switches(
+    n: int, init: Callable[[int], Switch], full_mesh: bool = True
+) -> list[Switch]:
+    """N started switches, fully meshed (reference
+    `MakeConnectedSwitches p2p/switch.go:502-524`)."""
+    switches = [init(i) for i in range(n)]
+    for s in switches:
+        s.start()
+    if full_mesh:
+        for i in range(n):
+            for j in range(i + 1, n):
+                connect_switches(switches[i], switches[j])
+    return switches

@@ -1,0 +1,133 @@
+"""Byte transports: in-memory pipes + fault injection.
+
+The in-memory pipe fills the slot of the reference's `net.Pipe` test
+transport (`p2p/switch.go:502-534` MakeConnectedSwitches wiring); the
+`Endpoint` interface is what a TCP/secret-connection transport plugs
+into later. `FuzzedEndpoint` is the network fault injector (reference
+`p2p/fuzz.go:19-47`: probabilistic drops, delays, connection death).
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+
+class EndpointClosed(Exception):
+    pass
+
+
+class Endpoint:
+    """One end of a bidirectional message-framed byte link.
+
+    send() never blocks forever (bounded queue, drop-on-close); recv()
+    blocks until a frame arrives or the link closes (raises
+    EndpointClosed). Framing is preserved: one send -> one recv.
+    """
+
+    def __init__(self, out_q: "queue.Queue", in_q: "queue.Queue") -> None:
+        self._out = out_q
+        self._in = in_q
+        self._closed = threading.Event()
+
+    def send(self, data: bytes, timeout: float = 10.0) -> bool:
+        if self._closed.is_set():
+            raise EndpointClosed
+        try:
+            self._out.put(data, timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    def recv(self, timeout: float | None = None) -> bytes:
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while True:
+            if self._closed.is_set() and self._in.empty():
+                raise EndpointClosed
+            remaining = 0.05
+            if deadline is not None:
+                remaining = min(remaining, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError
+            try:
+                item = self._in.get(timeout=remaining)
+            except queue.Empty:
+                continue
+            if item is _CLOSE:
+                self._closed.set()
+                raise EndpointClosed
+            return item
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        # wake the far side's recv
+        try:
+            self._out.put_nowait(_CLOSE)
+        except queue.Full:
+            pass
+        # wake our own blocked recv
+        try:
+            self._in.put_nowait(_CLOSE)
+        except queue.Full:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+_CLOSE = object()
+
+
+def pipe_pair(capacity: int = 1024) -> tuple[Endpoint, Endpoint]:
+    """Two connected in-memory endpoints (the net.Pipe analog)."""
+    a_to_b: "queue.Queue" = queue.Queue(maxsize=capacity)
+    b_to_a: "queue.Queue" = queue.Queue(maxsize=capacity)
+    return Endpoint(a_to_b, b_to_a), Endpoint(b_to_a, a_to_b)
+
+
+@dataclass
+class FuzzConfig:
+    """Reference `p2p/fuzz.go` FuzzConnConfig."""
+
+    prob_drop_rw: float = 0.0  # drop an individual send
+    prob_drop_conn: float = 0.0  # kill the link on a send
+    prob_sleep: float = 0.0  # delay a send
+    max_sleep_s: float = 0.05
+    seed: int | None = None
+
+
+class FuzzedEndpoint:
+    """Wrap an Endpoint with probabilistic faults (network fault
+    injection for tests — reference `p2p/fuzz.go:19-47`)."""
+
+    def __init__(self, inner: Endpoint, config: FuzzConfig) -> None:
+        self._inner = inner
+        self._cfg = config
+        self._rng = random.Random(config.seed)
+
+    def send(self, data: bytes, timeout: float = 10.0) -> bool:
+        c = self._cfg
+        if c.prob_drop_conn and self._rng.random() < c.prob_drop_conn:
+            self._inner.close()
+            raise EndpointClosed
+        if c.prob_drop_rw and self._rng.random() < c.prob_drop_rw:
+            return True  # silently dropped
+        if c.prob_sleep and self._rng.random() < c.prob_sleep:
+            time.sleep(self._rng.uniform(0, c.max_sleep_s))
+        return self._inner.send(data, timeout)
+
+    def recv(self, timeout: float | None = None) -> bytes:
+        return self._inner.recv(timeout)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed

@@ -19,6 +19,7 @@ buckets so recompiles stay bounded).
 
 from __future__ import annotations
 
+import os
 from typing import Iterable, Sequence
 
 import numpy as np
@@ -68,19 +69,37 @@ class HostBatchVerifier(BatchVerifier):
         return out
 
 
+# Below this many lanes the host library wins: every device launch has a
+# fixed cost (measured ~86 ms through the axon tunnel, docs/
+# PLATFORM_NOTES.md) while a host verify is ~60 us — single votes and
+# small commits must never wait on a kernel launch (the consensus hot
+# path verifies one gossiped vote at a time).
+DEVICE_MIN_BATCH = int(os.environ.get("TENDERMINT_TPU_MIN_DEVICE_BATCH", "512"))
+
+
 class DeviceBatchVerifier(BatchVerifier):
     """TPU-batched backend over `ops.ed25519_kernel.batch_verify`.
 
     Batches are padded to power-of-two buckets (min 8) inside
     batch_verify; compiled executables persist in the jit cache per
-    bucket size.
+    bucket size. Batches smaller than DEVICE_MIN_BATCH short-circuit to
+    the host library (launch overhead dominates there).
     """
 
-    def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
-        from tendermint_tpu.ops.ed25519_kernel import batch_verify
+    def __init__(self, min_device_batch: int | None = None) -> None:
+        super().__init__()
+        self._host = HostBatchVerifier()
+        self._min_batch = (
+            DEVICE_MIN_BATCH if min_device_batch is None else min_device_batch
+        )
 
+    def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
         if not triples:
             return np.zeros(0, dtype=bool)
+        if len(triples) < self._min_batch:
+            return self._host.verify_batch(triples)
+        from tendermint_tpu.ops.ed25519_kernel import batch_verify
+
         pubs, msgs, sigs = zip(*triples)
         return batch_verify(list(pubs), list(msgs), list(sigs))
 
@@ -97,8 +116,8 @@ class TableBatchVerifier(DeviceBatchVerifier):
     triples (proposal sigs, mixed-key batches).
     """
 
-    def __init__(self, cache_size: int = 4) -> None:
-        super().__init__()
+    def __init__(self, cache_size: int = 4, min_device_batch: int | None = None) -> None:
+        super().__init__(min_device_batch)
         from collections import OrderedDict
 
         self._tables: "OrderedDict[bytes, tuple]" = OrderedDict()
@@ -147,6 +166,21 @@ class TableBatchVerifier(DeviceBatchVerifier):
         k = len(commits)
         if n == 0 or k == 0:
             return np.zeros((k, n), dtype=bool)
+        if k * n < self._min_batch:
+            # small commits: host loop beats a device launch
+            out = np.zeros((k, n), dtype=bool)
+            for ci, (msgs, sigs) in enumerate(commits):
+                lanes = [
+                    i
+                    for i in range(n)
+                    if msgs[i] is not None and sigs[i] is not None
+                ]
+                lane_triples = [(pubkeys[i], msgs[i], sigs[i]) for i in lanes]
+                if lane_triples:
+                    verdicts = self._host.verify_batch(lane_triples)
+                    for i, v in zip(lanes, verdicts):
+                        out[ci, i] = v
+            return out
         # malformed pubkeys degrade to a False verdict (matching every
         # other backend) instead of corrupting the packed table build
         length_ok = np.array([len(pk) == 32 for pk in pubkeys], dtype=bool)

@@ -125,7 +125,7 @@ class TestTableBatchVerifier:
         from tendermint_tpu.services.verifier import TableBatchVerifier
 
         privs, pubs, msgs, sigs = _keyed_batch(3, seed=50)
-        v = TableBatchVerifier()
+        v = TableBatchVerifier(min_device_batch=1)
         out1 = v.verify_commits(pubs, [(msgs, sigs)])
         assert out1.shape == (1, 3) and out1.all()
         assert len(v._tables) == 1
@@ -140,7 +140,7 @@ class TestTableBatchVerifier:
         from tendermint_tpu.services.verifier import TableBatchVerifier
 
         _, pubs, msgs, sigs = _keyed_batch(2, seed=60)
-        v = TableBatchVerifier()
+        v = TableBatchVerifier(min_device_batch=1)
         out = v.verify_batch(list(zip(pubs, msgs, sigs)))
         assert out.all()
         assert len(v._tables) == 0  # ad-hoc triples skip the table cache
@@ -154,7 +154,7 @@ class TestTableBatchVerifier:
         vs, privs = make_validators(4)
         block_id = make_block_id()
         commit = make_commit(vs, privs, height=3, round_=0, block_id=block_id)
-        v = TableBatchVerifier()
+        v = TableBatchVerifier(min_device_batch=1)
         vs.verify_commit("test-chain", block_id, 3, commit, verifier=v)
         assert len(v._tables) == 1  # commit path used the table cache
 

@@ -1,0 +1,194 @@
+"""P2P backend: transport, multiplexed connection, switch/reactor.
+
+Mirrors the reference's `p2p/*_test.go` coverage: frame round-trips,
+channel dispatch, peer lifecycle, incompatible/duplicate peer
+rejection, error-driven peer drops, fuzzed links.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    FuzzConfig,
+    FuzzedEndpoint,
+    MConnection,
+    NodeInfo,
+    Reactor,
+    Switch,
+    connect_switches,
+    make_connected_switches,
+    pipe_pair,
+)
+from tendermint_tpu.p2p.transport import EndpointClosed
+
+
+def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestTransport:
+    def test_pipe_roundtrip(self):
+        a, b = pipe_pair()
+        a.send(b"hello")
+        assert b.recv(timeout=1) == b"hello"
+        b.send(b"world")
+        assert a.recv(timeout=1) == b"world"
+
+    def test_close_wakes_receiver(self):
+        a, b = pipe_pair()
+        got = queue.Queue()
+
+        def rx():
+            try:
+                b.recv()
+            except EndpointClosed:
+                got.put("closed")
+
+        t = threading.Thread(target=rx, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        a.close()
+        assert got.get(timeout=2) == "closed"
+
+    def test_fuzzed_drop_all(self):
+        a, b = pipe_pair()
+        fz = FuzzedEndpoint(a, FuzzConfig(prob_drop_rw=1.0, seed=1))
+        fz.send(b"dropped")
+        with pytest.raises(TimeoutError):
+            b.recv(timeout=0.2)
+
+
+class TestMConnection:
+    def test_multiplex_two_channels(self):
+        ea, eb = pipe_pair()
+        got = queue.Queue()
+        chans = [ChannelDescriptor(0x20), ChannelDescriptor(0x21)]
+        ca = MConnection(ea, chans, lambda c, p: None)
+        cb = MConnection(eb, chans, lambda c, p: got.put((c, p)))
+        ca.start()
+        cb.start()
+        try:
+            ca.send(0x20, b"state")
+            ca.send(0x21, b"data")
+            seen = {got.get(timeout=2), got.get(timeout=2)}
+            assert seen == {(0x20, b"state"), (0x21, b"data")}
+        finally:
+            ca.stop()
+            cb.stop()
+
+    def test_on_error_fires_on_link_death(self):
+        ea, eb = pipe_pair()
+        errs = queue.Queue()
+        ca = MConnection(ea, [ChannelDescriptor(1)], lambda c, p: None)
+        cb = MConnection(
+            eb, [ChannelDescriptor(1)], lambda c, p: None, lambda e: errs.put(e)
+        )
+        ca.start()
+        cb.start()
+        try:
+            ca.stop()  # closes the shared pipe
+            errs.get(timeout=2)  # cb notices
+        finally:
+            cb.stop()
+
+
+class EchoReactor(Reactor):
+    CH = 0x77
+
+    def __init__(self):
+        super().__init__()
+        self.received = queue.Queue()
+        self.peers_added = []
+        self.peers_removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.CH)]
+
+    def add_peer(self, peer):
+        self.peers_added.append(peer)
+
+    def remove_peer(self, peer, reason):
+        self.peers_removed.append((peer, reason))
+
+    def receive(self, chan_id, peer, payload):
+        if payload == b"explode":
+            raise RuntimeError("bad message")
+        self.received.put((peer.id, payload))
+
+
+def _mk_switch(i, chain_id="p2p-test"):
+    sw = Switch(NodeInfo(node_id=f"node{i}", moniker=f"m{i}", chain_id=chain_id))
+    sw.add_reactor("echo", EchoReactor())
+    return sw
+
+
+class TestSwitch:
+    def test_two_switches_exchange(self):
+        s0, s1 = make_connected_switches(2, _mk_switch)
+        try:
+            r0: EchoReactor = s0.reactor("echo")
+            r1: EchoReactor = s1.reactor("echo")
+            assert s0.n_peers() == 1 and s1.n_peers() == 1
+            s0.broadcast(EchoReactor.CH, b"ping")
+            peer_id, payload = r1.received.get(timeout=2)
+            assert (peer_id, payload) == ("node0", b"ping")
+            s1.peers()[0].send(EchoReactor.CH, b"pong")
+            assert r0.received.get(timeout=2) == ("node1", b"pong")
+        finally:
+            s0.stop()
+            s1.stop()
+
+    def test_chain_mismatch_rejected(self):
+        s0 = _mk_switch(0)
+        s1 = _mk_switch(1, chain_id="other-chain")
+        s0.start()
+        s1.start()
+        try:
+            with pytest.raises(ValueError, match="chain_id mismatch"):
+                connect_switches(s0, s1)
+            assert s0.n_peers() == 0
+        finally:
+            s0.stop()
+            s1.stop()
+
+    def test_duplicate_peer_rejected(self):
+        s0, s1 = make_connected_switches(2, _mk_switch)
+        try:
+            with pytest.raises(ValueError, match="duplicate"):
+                connect_switches(s0, s1)
+        finally:
+            s0.stop()
+            s1.stop()
+
+    def test_raising_reactor_drops_peer(self):
+        s0, s1 = make_connected_switches(2, _mk_switch)
+        try:
+            r1: EchoReactor = s1.reactor("echo")
+            s0.broadcast(EchoReactor.CH, b"explode")
+            wait_until(lambda: s1.n_peers() == 0, msg="peer dropped")
+            assert len(r1.peers_removed) == 1
+        finally:
+            s0.stop()
+            s1.stop()
+
+    def test_full_mesh(self):
+        switches = make_connected_switches(4, _mk_switch)
+        try:
+            for s in switches:
+                assert s.n_peers() == 3
+            switches[0].broadcast(EchoReactor.CH, b"hello-all")
+            for s in switches[1:]:
+                r: EchoReactor = s.reactor("echo")
+                assert r.received.get(timeout=2) == ("node0", b"hello-all")
+        finally:
+            for s in switches:
+                s.stop()

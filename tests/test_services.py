@@ -30,12 +30,14 @@ def _triples(n, corrupt=()):
 class TestBatchVerifier:
     @pytest.mark.parametrize("cls", [HostBatchVerifier, DeviceBatchVerifier])
     def test_verify_batch_localizes_failures(self, cls):
-        v = cls()
+        # min_device_batch=1 keeps DeviceBatchVerifier on the kernel path
+        # (the default threshold would silently route to the host)
+        v = cls() if cls is HostBatchVerifier else cls(min_device_batch=1)
         verdict = v.verify_batch(_triples(6, corrupt={1, 4}))
         assert verdict.tolist() == [True, False, True, True, False, True]
 
     def test_accumulate_flush(self):
-        v = DeviceBatchVerifier()
+        v = DeviceBatchVerifier(min_device_batch=1)
         triples = _triples(5, corrupt={2})
         idxs = [v.add(*t) for t in triples]
         assert idxs == [0, 1, 2, 3, 4]
@@ -54,7 +56,7 @@ class TestBatchVerifier:
     def test_host_device_agree(self):
         triples = _triples(9, corrupt={0, 8})
         host = HostBatchVerifier().verify_batch(triples)
-        dev = DeviceBatchVerifier().verify_batch(triples)
+        dev = DeviceBatchVerifier(min_device_batch=1).verify_batch(triples)
         assert (host == dev).all()
 
 
