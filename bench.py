@@ -176,6 +176,10 @@ def main() -> None:
     sys.stderr.write(f"devices: {jax.devices()}\n")
     t10k = _bench_verify_tables(10_240)
     sys.stderr.write(f"tables@10k: {t10k}\n")
+    # fast-sync shape at 1k validators (BASELINE config 3): a window of
+    # commits batched per device call -> blocks verified per second
+    t1k = _bench_verify_tables(1_024, stack=64)
+    sys.stderr.write(f"tables@1k x64: {t1k}\n")
     v1k = _bench_verify(1_000)
     sys.stderr.write(f"generic@1k: {v1k}\n")
     m = _bench_merkle(65_536)
@@ -191,6 +195,10 @@ def main() -> None:
             "commit_10k_validators_ms": t10k["commit_ms"],
             "fastsync_stack": t10k["stack"],
             "fastsync_batch_ms": round(t10k["stacked_warm_s"] * 1e3, 2),
+            "fastsync_blocks_per_s_1k_vals": round(
+                t1k["stack"] / t1k["stacked_warm_s"], 1
+            ),
+            "commit_1k_validators_ms": t1k["commit_ms"],
             "table_build_10k_s": t10k["table_build_s"],
             "host_prep_10k_s": t10k["host_prep_s"],
             "generic_ladder_verifies_per_s": round(v1k["verifies_per_s"], 1),

@@ -1,5 +1,7 @@
 """Block storage + fast-sync (reference `blockchain/`)."""
 
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
 from tendermint_tpu.blockchain.store import BlockMeta, BlockStore
 
-__all__ = ["BlockMeta", "BlockStore"]
+__all__ = ["BlockMeta", "BlockPool", "BlockStore", "BlockchainReactor"]

@@ -1,0 +1,178 @@
+"""BlockPool: parallel block download with peer accounting.
+
+Reference `blockchain/pool.go:49-64` — up to `max_pending` outstanding
+height requests spread over peers, per-request timeouts with
+reassignment, sorted assembly. The reference runs one goroutine per
+in-flight height; here a single scheduler tick (driven by the reactor's
+sync loop) computes which requests to (re)send — same behavior, no
+thread-per-height.
+
+The pool is pure bookkeeping: the reactor supplies a `send_request`
+callback and feeds `add_block`; verification/apply happens in the
+reactor's sync loop (batched through the TPU verifier — the whole point
+of fast-sync, BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+REQUEST_TIMEOUT_S = 15.0  # reference peerTimeout (pool.go:33)
+MAX_PENDING_PER_PEER = 20  # reference maxPendingRequestsPerPeer scaled
+
+
+@dataclass
+class _Request:
+    peer_id: str
+    sent_at: float
+
+
+class BlockPool:
+    def __init__(self, start_height: int, max_pending: int = 64) -> None:
+        # next height we still need to hand to the executor
+        self.height = start_height
+        self._lock = threading.RLock()
+        self._blocks: dict[int, tuple[object, str]] = {}  # height -> (block, peer)
+        self._requests: dict[int, _Request] = {}
+        self._peers: dict[str, int] = {}  # peer_id -> advertised height
+        self._max_pending = max_pending
+
+    # -- peers ---------------------------------------------------------------
+
+    def set_peer_height(self, peer_id: str, height: int) -> None:
+        with self._lock:
+            self._peers[peer_id] = height
+
+    def remove_peer(self, peer_id: str) -> None:
+        """Forget the peer; its in-flight requests become reassignable."""
+        with self._lock:
+            self._peers.pop(peer_id, None)
+            for h in [h for h, r in self._requests.items() if r.peer_id == peer_id]:
+                del self._requests[h]
+
+    def max_peer_height(self) -> int:
+        with self._lock:
+            return max(self._peers.values(), default=0)
+
+    def num_peers(self) -> int:
+        with self._lock:
+            return len(self._peers)
+
+    # -- scheduling ------------------------------------------------------------
+
+    def schedule_requests(
+        self, now: float | None = None
+    ) -> tuple[list[tuple[str, int]], list[str]]:
+        """One scheduler tick -> (requests to send, peers to evict).
+
+        A request that exceeds REQUEST_TIMEOUT_S evicts its peer — the
+        reference's `bpRequester` timeout drops the peer outright
+        (`pool.go:115ff`), which is also the byzantine defense: a peer
+        advertising a height it never serves would otherwise pin
+        `max_peer_height` above reach and keep fast-sync from ever
+        completing. Freed heights reschedule to the remaining peers in
+        the same tick (reference `makeRequestersRoutine`)."""
+        now = now if now is not None else time.monotonic()
+        out: list[tuple[str, int]] = []
+        evict: list[str] = []
+        with self._lock:
+            if not self._peers:
+                return [], []
+            for h, req in list(self._requests.items()):
+                if now - req.sent_at > REQUEST_TIMEOUT_S:
+                    if req.peer_id in self._peers and req.peer_id not in evict:
+                        evict.append(req.peer_id)
+            for peer_id in evict:
+                self._peers.pop(peer_id, None)
+                for h in [
+                    h for h, r in self._requests.items() if r.peer_id == peer_id
+                ]:
+                    del self._requests[h]
+            # new + freed requests
+            h = self.height
+            target = self.max_peer_height()
+            while len(self._requests) < self._max_pending and h <= target:
+                if h not in self._blocks and h not in self._requests:
+                    peer = self._pick_peer(h)
+                    if peer is None:
+                        break
+                    self._requests[h] = _Request(peer, now)
+                    out.append((peer, h))
+                h += 1
+        return out, evict
+
+    def _pick_peer(self, height: int, exclude: str | None = None) -> str | None:
+        """Least-loaded peer that advertises the height."""
+        loads: dict[str, int] = {p: 0 for p in self._peers}
+        for req in self._requests.values():
+            if req.peer_id in loads:
+                loads[req.peer_id] += 1
+        best, best_load = None, None
+        for p, max_h in self._peers.items():
+            if p == exclude or max_h < height:
+                continue
+            if loads[p] >= MAX_PENDING_PER_PEER:
+                continue
+            if best_load is None or loads[p] < best_load:
+                best, best_load = p, loads[p]
+        return best
+
+    # -- data ------------------------------------------------------------------
+
+    def add_block(self, peer_id: str, block) -> bool:
+        """Accept a response only for a height we requested from that
+        peer (reference `AddBlock pool.go:203-224`)."""
+        height = block.header.height
+        with self._lock:
+            req = self._requests.get(height)
+            if req is None or req.peer_id != peer_id:
+                return False
+            del self._requests[height]
+            self._blocks[height] = (block, peer_id)
+        return True
+
+    def peek(self, n: int) -> list:
+        """Up to n CONSECUTIVE blocks starting at self.height."""
+        with self._lock:
+            out = []
+            for h in range(self.height, self.height + n):
+                if h not in self._blocks:
+                    break
+                out.append(self._blocks[h][0])
+            return out
+
+    def pop(self) -> None:
+        """Advance past self.height (block was applied)."""
+        with self._lock:
+            self._blocks.pop(self.height, None)
+            self._requests.pop(self.height, None)
+            self.height += 1
+
+    def redo(self, height: int) -> str | None:
+        """A block failed verification: drop it (and everything after —
+        they chain off it) and return the peer that sent it so the
+        switch can drop the peer (reference `RedoRequest`)."""
+        with self._lock:
+            bad_peer = None
+            if height in self._blocks:
+                bad_peer = self._blocks[height][1]
+            for h in list(self._blocks):
+                if h >= height:
+                    del self._blocks[h]
+            for h in list(self._requests):
+                if h >= height:
+                    del self._requests[h]
+            return bad_peer
+
+    def is_caught_up(self) -> bool:
+        """Within one block of every peer's tip (with at least one peer
+        heard from — reference `IsCaughtUp pool.go:170-185`). The TIP
+        block itself cannot fast-sync: its commit travels in its
+        successor's LastCommit, so consensus takes over for it."""
+        with self._lock:
+            if not self._peers:
+                return False
+            return self.height >= self.max_peer_height()

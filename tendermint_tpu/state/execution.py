@@ -26,10 +26,15 @@ class BlockExecutionError(Exception):
     pass
 
 
-def validate_block(state: State, block: Block, verifier=None) -> None:
+def validate_block(
+    state: State, block: Block, verifier=None, commit_preverified: bool = False
+) -> None:
     """Reference `validateBlock` (`state/execution.go:181-206`): header
     fields against state, then LastCommit against LastValidators — the
-    latter as one signature batch."""
+    latter as one signature batch. `commit_preverified=True` skips the
+    LastCommit signature pass ONLY (structure still checked): fast-sync
+    batch-verifies whole windows of commits in one device call before
+    applying, so re-verifying per block would double the work."""
     block.validate_basic()
     if block.header.chain_id != state.chain_id:
         raise ValidationError(
@@ -58,13 +63,14 @@ def validate_block(state: State, block: Block, verifier=None) -> None:
                 f"wrong LastCommit size: got {len(block.last_commit.precommits)}, "
                 f"want {state.last_validators.size()}"
             )
-        state.last_validators.verify_commit(
-            state.chain_id,
-            state.last_block_id,
-            block.header.height - 1,
-            block.last_commit,
-            verifier=verifier,
-        )
+        if not commit_preverified:
+            state.last_validators.verify_commit(
+                state.chain_id,
+                state.last_block_id,
+                block.header.height - 1,
+                block.last_commit,
+                verifier=verifier,
+            )
 
 
 def exec_block_on_proxy_app(
@@ -95,11 +101,14 @@ def apply_block(
     verifier=None,
     tx_indexer=None,
     on_tx_result: Callable[[int, bytes, Result], None] | None = None,
+    commit_preverified: bool = False,
 ) -> State:
     """Validate, execute, persist; returns the advanced state
     (reference `ApplyBlock state/execution.go:216-249`). Mutates and
     returns `state`; callers pass a copy when they need the original."""
-    validate_block(state, block, verifier=verifier)
+    validate_block(
+        state, block, verifier=verifier, commit_preverified=commit_preverified
+    )
 
     fail_point()  # before any execution effects
     abci_responses = exec_block_on_proxy_app(app_conn, block, on_tx_result)

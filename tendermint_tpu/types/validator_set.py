@@ -194,39 +194,79 @@ class ValidatorSet:
 
         Reference `VerifyCommit types/validator_set.go:225-269` — but instead
         of one ed25519 verify per iteration, all signatures flush as a single
-        device batch when a `BatchVerifier` is supplied. Verifiers exposing
-        `verify_commits` (the valset-table cache) get the commit in
-        validator-lane order so repeated commits of one valset hit cached
-        per-validator comb tables.
+        device batch when a `BatchVerifier` is supplied. The K=1 case of
+        `verify_commit_batched`.
         """
-        triples, indices = self._collect_commit_sigs(chain_id, block_id, height, commit)
+        self.verify_commit_batched(
+            chain_id, [(block_id, height, commit)], verifier
+        )
+
+    def verify_commit_batched(
+        self,
+        chain_id: str,
+        entries: list[tuple[BlockID, int, "object"]],
+        verifier=None,
+    ) -> None:
+        """Verify K commits signed by THIS validator set as one device
+        batch — the fast-sync window shape (BASELINE config 3; reference
+        verifies one commit per loop iteration at
+        `blockchain/reactor.go:259`). `entries` is a list of
+        (block_id, height, commit). Raises naming the failing validator
+        (and entry, when K > 1). Verifiers exposing `verify_commits`
+        (the valset-table cache) get commits in validator-lane order so
+        repeated commits of one valset hit cached per-validator comb
+        tables; other verifiers get flat triple batches.
+        """
         if verifier is None:
             from tendermint_tpu.services.verifier import default_verifier
 
             verifier = default_verifier()
-        if triples and hasattr(verifier, "verify_commits"):
-            n = len(self.validators)
-            msgs: list[bytes | None] = [None] * n
-            sigs: list[bytes | None] = [None] * n
-            for (pk, msg, sig), idx in zip(triples, indices):
-                msgs[idx], sigs[idx] = msg, sig
+        collected = [
+            self._collect_commit_sigs(chain_id, bid, h, c)
+            for bid, h, c in entries
+        ]
+        n = len(self.validators)
+        if hasattr(verifier, "verify_commits") and any(
+            triples for triples, _ in collected
+        ):
+            lanes: list[tuple[list, list]] = []
+            for triples, indices in collected:
+                msgs: list[bytes | None] = [None] * n
+                sigs: list[bytes | None] = [None] * n
+                for (pk, msg, sig), idx in zip(triples, indices):
+                    msgs[idx], sigs[idx] = msg, sig
+                lanes.append((msgs, sigs))
             grid = verifier.verify_commits(
-                [v.pub_key.data for v in self.validators], [(msgs, sigs)]
+                [v.pub_key.data for v in self.validators], lanes
             )
-            ok_mask = [bool(grid[0][i]) for i in indices]
+            ok_by_entry = [
+                [bool(grid[ei][i]) for i in indices]
+                for ei, (_, indices) in enumerate(collected)
+            ]
         else:
-            ok_mask = _verify_triples(triples, verifier)
-        tallied = 0
-        for ok, idx in zip(ok_mask, indices):
-            precommit = commit.precommits[idx]
-            if not ok:
-                raise ValidationError(f"invalid commit signature from validator {idx}")
-            if precommit.block_id == block_id:
-                tallied += self.validators[idx].voting_power
-        if not tallied * 3 > self._total * 2:
-            raise ValidationError(
-                f"insufficient voting power: {tallied} of {self._total}"
-            )
+            ok_by_entry = [
+                _verify_triples(triples, verifier) for triples, _ in collected
+            ]
+        for ei, ((block_id, height, commit), (_, indices), oks) in enumerate(
+            zip(entries, collected, ok_by_entry)
+        ):
+            tallied = 0
+            for ok, idx in zip(oks, indices):
+                if not ok:
+                    where = (
+                        f" (batch entry {ei}, height {height})"
+                        if len(entries) > 1
+                        else ""
+                    )
+                    raise ValidationError(
+                        f"invalid commit signature from validator {idx}{where}"
+                    )
+                if commit.precommits[idx].block_id == block_id:
+                    tallied += self.validators[idx].voting_power
+            if not tallied * 3 > self._total * 2:
+                raise ValidationError(
+                    f"insufficient voting power: {tallied} of {self._total}"
+                )
 
     def verify_commit_any(
         self, new_set: "ValidatorSet", chain_id: str, block_id: BlockID, height: int, commit, verifier=None
