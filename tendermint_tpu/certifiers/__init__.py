@@ -1,0 +1,34 @@
+"""Light client: certify headers without replaying the chain
+(reference `certifiers/`).
+
+A light client holds a trusted validator set and certifies incoming
+(header, commit) pairs against it; validator-set changes are followed
+with the >2/3-continuity rule (`VerifyCommitAny`), bisecting through
+stored intermediate commits when one jump changes too much.
+
+TPU angle (BASELINE config 2): commit replay is embarrassingly
+batchable — `StaticCertifier.certify_batch` verifies K same-valset
+commits in one device call through the valset-table kernel.
+"""
+
+from tendermint_tpu.certifiers.certifier import (
+    DynamicCertifier,
+    FullCommit,
+    InquiringCertifier,
+    StaticCertifier,
+)
+from tendermint_tpu.certifiers.provider import (
+    FileProvider,
+    MemProvider,
+    Provider,
+)
+
+__all__ = [
+    "DynamicCertifier",
+    "FileProvider",
+    "FullCommit",
+    "InquiringCertifier",
+    "MemProvider",
+    "Provider",
+    "StaticCertifier",
+]

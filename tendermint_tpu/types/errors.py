@@ -48,6 +48,19 @@ class ErrVoteConflictingVotes(VoteError):
         self.vote_b = vote_b
 
 
+class ErrValidatorsChanged(ValidationError):
+    """A commit's validators hash differs from the certifier's trusted
+    set (reference `certifiers/errors.go` IsValidatorsChangedErr)."""
+
+
+class ErrTooMuchChange(ValidationError):
+    """The trusted validator set overlaps the commit's signers by less
+    than the 2/3 continuity rule — a light client cannot jump this far
+    in one step and must bisect (reference `certifiers/errors.go`
+    IsTooMuchChangeErr, raised from `VerifyCommitAny
+    types/validator_set.go:284-349`)."""
+
+
 class ErrDoubleSign(TMError):
     """PrivValidator refused to sign: height/round/step regression or
     conflicting sign-bytes (reference `types/priv_validator.go:225-275`)."""

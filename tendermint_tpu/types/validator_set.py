@@ -15,7 +15,7 @@ from tendermint_tpu.codec import Writer
 from tendermint_tpu.crypto import PubKey
 from tendermint_tpu.merkle import simple_hash_from_byte_slices
 from tendermint_tpu.types.block_id import BlockID
-from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.types.errors import ErrTooMuchChange, ValidationError
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
 
 
@@ -55,6 +55,7 @@ class ValidatorSet:
         self._total = sum(v.voting_power for v in self.validators)
         self._proposer: Validator | None = None
         self._addr_index: dict[bytes, int] | None = None
+        self._hash: bytes | None = None
 
     # -- basic accessors ---------------------------------------------------
 
@@ -126,8 +127,15 @@ class ValidatorSet:
     # -- hashing -----------------------------------------------------------
 
     def hash(self) -> bytes:
-        """Merkle root of the validator encodings (reference `Hash :145`)."""
-        return simple_hash_from_byte_slices([v.encode() for v in self.validators])
+        """Merkle root of the validator encodings (reference `Hash :145`).
+        Cached: the encoding covers address/pubkey/power only, so accum
+        rotation (increment_accum) does not change it; membership/power
+        changes invalidate in apply_changes."""
+        if self._hash is None:
+            self._hash = simple_hash_from_byte_slices(
+                [v.encode() for v in self.validators]
+            )
+        return self._hash
 
     # -- membership changes (EndBlock diffs) --------------------------------
 
@@ -154,6 +162,7 @@ class ValidatorSet:
         self._total = sum(v.voting_power for v in self.validators)
         self._proposer = None
         self._addr_index = None
+        self._hash = None
 
     # -- commit verification (the hot loop) ---------------------------------
 
@@ -315,9 +324,10 @@ class ValidatorSet:
             new_tallied += np_
         # BOTH quorums must hold: >2/3 of the old (trusted) set AND >2/3 of the
         # new set — otherwise a grown set could be "committed" by a minority of
-        # its power (reference validator_set.go:340-346).
+        # its power (reference validator_set.go:340-346). The old-quorum
+        # failure is typed so the light client can trigger bisection.
         if not old_tallied * 3 > self._total * 2:
-            raise ValidationError(
+            raise ErrTooMuchChange(
                 f"insufficient old voting power: {old_tallied} of {self._total}"
             )
         if not new_tallied * 3 > new_set.total_voting_power * 2:

@@ -1,0 +1,177 @@
+"""Light client: static/dynamic/inquiring certifiers + providers
+(reference `certifiers/*_test.go`; BASELINE config 2 batched replay).
+"""
+
+import pytest
+
+from tendermint_tpu.certifiers import (
+    DynamicCertifier,
+    FileProvider,
+    FullCommit,
+    InquiringCertifier,
+    MemProvider,
+    StaticCertifier,
+)
+from tendermint_tpu.crypto import PrivKey
+from tendermint_tpu.types import PrivValidator, Validator, ValidatorSet
+from tendermint_tpu.types.block import Header
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.errors import (
+    ErrTooMuchChange,
+    ErrValidatorsChanged,
+    ValidationError,
+)
+from tendermint_tpu.types.part_set import PartSetHeader
+
+from tests.helpers import make_commit
+
+CHAIN = "light-chain"
+
+
+def _privs(indices):
+    return [PrivValidator(PrivKey(i.to_bytes(32, "little"))) for i in indices]
+
+
+def _valset(privs, power=10):
+    return ValidatorSet(
+        [Validator(address=p.address, pub_key=p.pub_key, voting_power=power) for p in privs]
+    )
+
+
+def _full_commit(height, privs, app_hash=b"app"):
+    """FullCommit at `height` signed by `privs`' valset."""
+    vs = _valset(privs)
+    header = Header(
+        chain_id=CHAIN,
+        height=height,
+        time=height * 1_000_000_000,
+        num_txs=0,
+        last_block_id=BlockID.zero(),
+        last_commit_hash=b"",
+        data_hash=b"",
+        validators_hash=vs.hash(),
+        app_hash=app_hash,
+    )
+    block_id = BlockID(header.hash(), PartSetHeader(total=1, hash=header.hash()[:20]))
+    ordered = sorted(privs, key=lambda p: p.address)
+    commit = make_commit(vs, ordered, height, 0, block_id, CHAIN)
+    return FullCommit(header=header, commit=commit, validators=vs)
+
+
+class TestStaticCertifier:
+    def test_certify_and_batch(self):
+        privs = _privs(range(1, 5))
+        fcs = [_full_commit(h, privs) for h in (5, 6, 7)]
+        cert = StaticCertifier(CHAIN, _valset(privs))
+        cert.certify(fcs[0])
+        cert.certify_batch(fcs)  # config-2 shape: K commits, one call
+
+    def test_rejects_wrong_chain_and_forged_sig(self):
+        privs = _privs(range(1, 5))
+        fc = _full_commit(3, privs)
+        with pytest.raises(ValidationError, match="chain"):
+            StaticCertifier("other", _valset(privs)).certify(fc)
+        # forge one signature
+        bad = fc.commit.precommits[1]
+        sig = bytearray(bad.signature)
+        sig[5] ^= 1
+        fc.commit.precommits[1] = bad.with_signature(bytes(sig))
+        with pytest.raises(ValidationError, match="validator 1"):
+            StaticCertifier(CHAIN, _valset(privs)).certify(fc)
+
+    def test_validators_changed_is_typed(self):
+        fc = _full_commit(3, _privs(range(1, 5)))
+        other = _valset(_privs(range(10, 14)))
+        with pytest.raises(ErrValidatorsChanged):
+            StaticCertifier(CHAIN, other).certify(fc)
+
+
+class TestDynamicCertifier:
+    def test_update_follows_small_change(self):
+        old = _privs([1, 2, 3, 4])
+        new = _privs([1, 2, 3, 5])  # one of four replaced: 75% overlap
+        cert = DynamicCertifier(CHAIN, _valset(old), height=1)
+        fc = _full_commit(10, new)
+        cert.update(fc)
+        assert cert.last_height == 10
+        cert.certify(_full_commit(11, new))
+
+    def test_update_rejects_large_change(self):
+        old = _privs([1, 2, 3, 4])
+        new = _privs([1, 2, 5, 6])  # half replaced: 50% < 2/3
+        cert = DynamicCertifier(CHAIN, _valset(old), height=1)
+        with pytest.raises(ErrTooMuchChange):
+            cert.update(_full_commit(10, new))
+
+    def test_update_height_must_increase(self):
+        privs = _privs([1, 2, 3, 4])
+        cert = DynamicCertifier(CHAIN, _valset(privs), height=10)
+        with pytest.raises(ValidationError, match="height"):
+            cert.update(_full_commit(5, privs))
+
+
+class TestInquiringCertifier:
+    def _chain(self):
+        """heights 1..4 rotate one validator each: any 2-step jump
+        changes half the set (> 1/3), forcing bisection."""
+        sets = {
+            1: _privs([1, 2, 3, 4]),
+            2: _privs([1, 2, 3, 5]),
+            3: _privs([1, 2, 5, 6]),
+            4: _privs([1, 5, 6, 7]),
+        }
+        return {h: _full_commit(h, p) for h, p in sets.items()}
+
+    def test_bisection_across_large_total_change(self):
+        fcs = self._chain()
+        source = MemProvider()
+        for fc in fcs.values():
+            source.store_commit(fc)
+        trusted = MemProvider()
+        inq = InquiringCertifier(CHAIN, fcs[1], trusted, source)
+        # direct 1->4 changed 3 of 4 validators; must bisect via 2 and 3
+        inq.certify(fcs[4])
+        assert inq.cert.last_height == 4
+        # intermediate hops became trusted
+        assert trusted.get_by_height(3).height() >= 2
+
+    def test_fails_without_intermediate_commits(self):
+        fcs = self._chain()
+        source = MemProvider()
+        source.store_commit(fcs[1])
+        source.store_commit(fcs[4])  # gap: no 2, 3
+        inq = InquiringCertifier(CHAIN, fcs[1], MemProvider(), source)
+        with pytest.raises(ErrTooMuchChange):
+            inq.certify(fcs[4])
+
+    def test_same_valset_certifies_without_update(self):
+        privs = _privs([1, 2, 3, 4])
+        seed = _full_commit(1, privs)
+        inq = InquiringCertifier(CHAIN, seed, MemProvider(), MemProvider())
+        inq.certify(_full_commit(7, privs))
+
+
+class TestProviders:
+    def test_mem_provider_floor_lookup(self):
+        p = MemProvider()
+        privs = _privs([1, 2, 3, 4])
+        for h in (2, 5, 9):
+            p.store_commit(_full_commit(h, privs))
+        assert p.get_by_height(1) is None
+        assert p.get_by_height(5).height() == 5
+        assert p.get_by_height(8).height() == 5
+        assert p.latest_commit().height() == 9
+
+    def test_file_provider_round_trip(self, tmp_path):
+        p = FileProvider(str(tmp_path / "trust"))
+        privs = _privs([1, 2, 3, 4])
+        fc = _full_commit(12, privs)
+        p.store_commit(fc)
+        # fresh instance reads the same directory (restart survival)
+        p2 = FileProvider(str(tmp_path / "trust"))
+        got = p2.get_by_height(100)
+        assert got.height() == 12
+        assert got.header.hash() == fc.header.hash()
+        assert got.validators.hash() == fc.validators.hash()
+        # decoded commit still certifies
+        StaticCertifier(CHAIN, got.validators).certify(got)
