@@ -1,0 +1,3 @@
+from tendermint_tpu.cmd import main
+
+raise SystemExit(main())

@@ -85,6 +85,7 @@ class BlockchainReactor(Reactor):
         fast_sync: bool = False,
         on_caught_up=None,
         verifier=None,
+        tx_indexer=None,
     ) -> None:
         super().__init__()
         self.state = state
@@ -93,6 +94,7 @@ class BlockchainReactor(Reactor):
         self.fast_sync = fast_sync
         self.on_caught_up = on_caught_up
         self.verifier = verifier
+        self.tx_indexer = tx_indexer
         self.pool = BlockPool(start_height=store.height + 1)
         self._running = False
         self._thread: threading.Thread | None = None
@@ -238,6 +240,7 @@ class BlockchainReactor(Reactor):
                         parts[i].header,
                         self.app_conn,
                         verifier=self.verifier,
+                        tx_indexer=self.tx_indexer,
                         commit_preverified=True,
                     )
                 except ValidationError:
@@ -277,6 +280,7 @@ class BlockchainReactor(Reactor):
                 parts.header,
                 self.app_conn,
                 verifier=self.verifier,
+                tx_indexer=self.tx_indexer,
                 commit_preverified=True,
             )
         except ValidationError:

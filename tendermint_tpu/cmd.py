@@ -1,0 +1,162 @@
+"""CLI: init / node / testnet / show_validator / version
+(reference `cmd/tendermint/main.go:14-37` + `commands/`).
+
+Run as `python -m tendermint_tpu <command> [--home DIR] ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import time
+
+
+def _cmd_init(args) -> int:
+    """Create config.toml, genesis.json, priv_validator.json (reference
+    `commands/init.go`)."""
+    from tendermint_tpu.config import Config, write_config
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.priv_validator import PrivValidatorFS
+
+    cfg = Config.default(args.home)
+    os.makedirs(args.home, exist_ok=True)
+    write_config(cfg)
+    pv = PrivValidatorFS.load_or_gen(cfg.priv_validator_path())
+    if not os.path.exists(cfg.genesis_path()):
+        gen = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time=time.time_ns(),
+            validators=[GenesisValidator(pub_key=pv.pub_key, power=10)],
+        )
+        gen.save_as(cfg.genesis_path())
+    print(f"initialized node home at {args.home}")
+    return 0
+
+
+def _cmd_show_validator(args) -> int:
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.types.priv_validator import PrivValidatorFS
+
+    cfg = load_config(args.home)
+    pv = PrivValidatorFS.load(cfg.priv_validator_path())
+    print(pv.pub_key.data.hex())
+    return 0
+
+
+def _cmd_node(args) -> int:
+    """Run a node until interrupted (reference `commands/run_node.go`)."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.node import Node
+
+    cfg = load_config(args.home)
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.seeds:
+        cfg.p2p.seeds = args.seeds
+    if args.fast_sync is not None:
+        cfg.base.fast_sync = args.fast_sync
+
+    node = Node(cfg)
+    node.start()
+    print(
+        f"node {node.node_id[:12]} up: p2p :{node.p2p_port} rpc :{node.rpc_port}",
+        flush=True,
+    )
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def _cmd_testnet(args) -> int:
+    """Generate N validator node homes wired to each other (reference
+    `commands/testnet.go:29-50`)."""
+    from tendermint_tpu.config import Config, write_config
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from tendermint_tpu.types.priv_validator import PrivValidatorFS
+
+    n = args.n
+    base_p2p = args.starting_port
+    homes = [os.path.join(args.output, f"node{i}") for i in range(n)]
+    pvs = []
+    for home in homes:
+        os.makedirs(home, exist_ok=True)
+        pvs.append(
+            PrivValidatorFS.load_or_gen(os.path.join(home, "priv_validator.json"))
+        )
+    gen = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time=time.time_ns(),
+        validators=[GenesisValidator(pub_key=pv.pub_key, power=10) for pv in pvs],
+    )
+    for i, home in enumerate(homes):
+        cfg = Config.default(home)
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{base_p2p + 2 * i + 1}"
+        cfg.p2p.seeds = ",".join(
+            f"127.0.0.1:{base_p2p + 2 * j}" for j in range(n) if j != i
+        )
+        write_config(cfg)
+        gen.save_as(os.path.join(home, "genesis.json"))
+    print(f"wrote {n} node homes under {args.output}")
+    return 0
+
+
+def _cmd_version(args) -> int:
+    from tendermint_tpu.version import __version__
+
+    print(__version__)
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tendermint_tpu", description="TPU-native BFT consensus node"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize a node home directory")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.add_argument("--chain-id", default="")
+    p.set_defaults(fn=_cmd_init)
+
+    p = sub.add_parser("node", help="run a node")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.add_argument("--p2p-laddr", default="")
+    p.add_argument("--rpc-laddr", default="")
+    p.add_argument("--seeds", default="")
+    p.add_argument(
+        "--fast-sync", type=lambda s: s.lower() != "false", default=None
+    )
+    p.set_defaults(fn=_cmd_node)
+
+    p = sub.add_parser("testnet", help="generate an N-node local testnet")
+    p.add_argument("--n", type=int, default=4)
+    p.add_argument("--output", default="./mytestnet")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--starting-port", type=int, default=46656)
+    p.set_defaults(fn=_cmd_testnet)
+
+    p = sub.add_parser("show_validator", help="print the validator pubkey")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.set_defaults(fn=_cmd_show_validator)
+
+    p = sub.add_parser("version", help="print the version")
+    p.set_defaults(fn=_cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Typed config tree + TOML persistence (reference `config/config.go`,
+`config/toml.go`).
+
+Defaults match the reference's shape: Base (home/moniker/fast-sync),
+RPC, P2P, Mempool, Consensus sub-configs, with `Default*` and `Test*`
+presets. `load_config` merges `$home/config.toml` over defaults;
+`write_config` emits a commented TOML on `init`.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+
+
+@dataclass
+class BaseConfig:
+    """Reference `config/config.go:57-132`."""
+
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_dir: str = "data"
+    log_level: str = "state:info,*:error"
+    genesis_file: str = "genesis.json"
+    priv_validator_file: str = "priv_validator.json"
+
+
+@dataclass
+class RPCConfig:
+    """Reference `config/config.go:163-193`."""
+
+    laddr: str = "tcp://127.0.0.1:46657"
+    grpc_laddr: str = ""
+    unsafe: bool = False
+
+
+@dataclass
+class P2PConfig:
+    """Reference `config/config.go:199-256`."""
+
+    laddr: str = "tcp://0.0.0.0:46656"
+    seeds: str = ""  # comma-separated host:port
+    persistent_peers: str = ""
+    max_num_peers: int = 50
+    send_rate: int = 512000  # bytes/s (flow limits live in MConnection)
+    recv_rate: int = 512000
+
+
+@dataclass
+class MempoolConfig:
+    """Reference `config/config.go:267-288`."""
+
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = "data/mempool.wal"
+    cache_size: int = 100_000
+
+
+@dataclass
+class Config:
+    home: str = "."
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+
+    # -- derived paths -----------------------------------------------------
+
+    def genesis_path(self) -> str:
+        return os.path.join(self.home, self.base.genesis_file)
+
+    def priv_validator_path(self) -> str:
+        return os.path.join(self.home, self.base.priv_validator_file)
+
+    def db_path(self, name: str) -> str:
+        return os.path.join(self.home, self.base.db_dir, f"{name}.db")
+
+    def wal_path(self) -> str:
+        return os.path.join(self.home, self.base.db_dir, "cs.wal")
+
+    def mempool_wal_path(self) -> str:
+        return os.path.join(self.home, self.mempool.wal_dir)
+
+    @classmethod
+    def default(cls, home: str = ".") -> "Config":
+        return cls(home=home)
+
+    @classmethod
+    def test_config(cls, home: str = ".") -> "Config":
+        """Shrunk timeouts, ephemeral ports (reference `TestConfig`)."""
+        cfg = cls(home=home, consensus=ConsensusConfig.test_config())
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        return cfg
+
+
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus")
+
+
+def write_config(cfg: Config) -> str:
+    """Emit `$home/config.toml` (reference `config/toml.go`)."""
+    lines = ["# tendermint_tpu configuration\n"]
+    for section in _SECTIONS:
+        sub = getattr(cfg, section)
+        lines.append(f"[{section}]")
+        for f in fields(sub):
+            v = getattr(sub, f.name)
+            if isinstance(v, bool):
+                tv = "true" if v else "false"
+            elif isinstance(v, (int, float)):
+                tv = str(v)
+            else:
+                tv = '"%s"' % str(v).replace("\\", "\\\\").replace('"', '\\"')
+            lines.append(f"{f.name} = {tv}")
+        lines.append("")
+    path = os.path.join(cfg.home, "config.toml")
+    os.makedirs(cfg.home, exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines))
+    return path
+
+
+def load_config(home: str) -> Config:
+    """Defaults overlaid with `$home/config.toml` when present."""
+    import tomllib
+
+    cfg = Config.default(home)
+    path = os.path.join(home, "config.toml")
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "rb") as fh:
+        doc = tomllib.load(fh)
+    for section in _SECTIONS:
+        sub = getattr(cfg, section)
+        for f in fields(sub):
+            if section in doc and f.name in doc[section]:
+                setattr(sub, f.name, doc[section][f.name])
+    return cfg

@@ -458,9 +458,12 @@ class ConsensusReactor(Reactor):
         self.cs.stop()
 
     def switch_to_consensus(self, state) -> None:
-        """Fast-sync caught up: start the consensus loop on the synced
-        state (reference `SwitchToConsensus consensus/reactor.go:79-96`)."""
+        """Fast-sync caught up: adopt the synced state and start the
+        consensus loop (reference `SwitchToConsensus
+        consensus/reactor.go:79-96`)."""
         self.fast_sync = False
+        if state.last_block_height > 0:
+            self.cs.update_to_state(state)
         self.cs.start()
 
     def add_peer(self, peer: Peer) -> None:

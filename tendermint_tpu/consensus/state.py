@@ -75,6 +75,7 @@ class ConsensusState:
         wal_path: str | None = None,
         ticker=None,
         verifier=None,
+        tx_indexer=None,
     ) -> None:
         self.config = config
         self.app_conn = app_conn
@@ -83,6 +84,7 @@ class ConsensusState:
         self.priv_validator = priv_validator
         self.event_switch = event_switch if event_switch is not None else ev.EventSwitch()
         self.verifier = verifier
+        self.tx_indexer = tx_indexer
         self.wal = WAL(wal_path, light=config.wal_light) if wal_path else None
 
         self._queue: "queue.Queue" = queue.Queue()
@@ -136,6 +138,16 @@ class ConsensusState:
         self._thread = threading.Thread(target=self._receive_loop, daemon=True)
         self._thread.start()
         self._schedule_round0()
+
+    def update_to_state(self, state: State) -> None:
+        """Adopt an externally-advanced state BEFORE start() — the
+        fast-sync handoff (reference `SwitchToConsensus
+        consensus/reactor.go:79-96` calls updateToState with the synced
+        state). Must not be called while the receive loop runs."""
+        if self._running:
+            raise ValidationError("update_to_state on a running consensus")
+        with self._mtx:
+            self._update_to_state(state)
 
     def stop(self) -> None:
         self._running = False
@@ -725,6 +737,7 @@ class ConsensusState:
 
             fail_point()  # ENDHEIGHT written, before ApplyBlock
             state_copy = self.state.copy()
+            tx_results: list[tuple[bytes, object]] = []
             apply_block(
                 state_copy,
                 block,
@@ -732,6 +745,8 @@ class ConsensusState:
                 self.app_conn,
                 mempool=self.mempool,
                 verifier=self.verifier,
+                tx_indexer=self.tx_indexer,
+                on_tx_result=lambda i, tx, res: tx_results.append((tx, res)),
             )
 
             fail_point()  # applied, before round-state reset
@@ -748,6 +763,16 @@ class ConsensusState:
         self.event_switch.fire(
             ev.EVENT_NEW_BLOCK_HEADER, ev.EventDataNewBlockHeader(block.header)
         )
+        # per-tx results: generic stream + hash-keyed (broadcast_tx_commit
+        # waits on the keyed event — reference EventDataTx via event cache)
+        from tendermint_tpu.types.tx import tx_hash
+
+        for tx, res in tx_results:
+            data = ev.EventDataTx(
+                height=height, tx=tx, data=res.data, log=res.log, code=res.code
+            )
+            self.event_switch.fire(ev.EVENT_TX, data)
+            self.event_switch.fire(ev.event_tx(tx_hash(tx)), data)
         self._schedule_round0()
 
     # ---------------------------------------------------------------- votes

@@ -190,14 +190,21 @@ class Mempool:
             self._notified_available = True
             self._fire_available()
 
-    def get_after(self, index: int, wait: bool = False, timeout: float | None = None) -> list[bytes]:
-        """Txs at positions > index — the gossip iteration seam
-        (role of clist's TxsFront/NextWait). With wait=True blocks until
-        a tx beyond `index` exists or timeout."""
+    def get_after(
+        self, counter: int, wait: bool = False, timeout: float | None = None
+    ) -> list[tuple[int, bytes]]:
+        """(counter, tx) pairs with counter > `counter` — the gossip
+        iteration seam (role of clist's TxsFront/NextWait). Cursors are
+        the monotonically-increasing intake counter, NOT list positions:
+        update() compacts the list after every commit, so a positional
+        cursor would skip or stall. With wait=True blocks until a newer
+        tx exists or timeout."""
         with self._lock:
-            if wait and len(self._txs) <= index:
+            out = [(m.counter, m.tx) for m in self._txs if m.counter > counter]
+            if wait and not out:
                 self._txs_available.wait(timeout)
-            return [m.tx for m in self._txs[index:]]
+                out = [(m.counter, m.tx) for m in self._txs if m.counter > counter]
+            return out
 
     def close(self) -> None:
         if self._wal is not None:

@@ -1,0 +1,83 @@
+"""Mempool gossip reactor: stream valid txs to peers (reference
+`mempool/reactor.go:20,27,74,114-152`, channel 0x30).
+
+One broadcast thread per peer walks the mempool's append-order list via
+the `get_after(index, wait=True)` seam (the reference blocks on
+`clist.NextWait`); received txs feed `check_tx` (dup-cache +
+app-validated before joining the pool).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+
+MEMPOOL_CHANNEL = 0x30
+
+_MSG_TX = 0x01
+
+
+def encode_tx_message(tx: bytes) -> bytes:
+    return Writer().uvarint(_MSG_TX).bytes(tx).build()
+
+
+def decode_tx_message(payload: bytes) -> bytes:
+    r = Reader(payload)
+    if r.uvarint() != _MSG_TX:
+        raise ValueError("unknown mempool message")
+    return r.bytes()
+
+
+class MempoolReactor(Reactor):
+    PEER_KEY = "mempool_peer_alive"
+
+    def __init__(self, mempool, broadcast: bool = True) -> None:
+        super().__init__()
+        self.mempool = mempool
+        self.broadcast = broadcast
+        self._running = False
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(MEMPOOL_CHANNEL, priority=1)]
+
+    def on_start(self) -> None:
+        self._running = True
+
+    def on_stop(self) -> None:
+        self._running = False
+
+    def add_peer(self, peer: Peer) -> None:
+        if not self.broadcast:
+            return
+        peer.set(self.PEER_KEY, True)
+        threading.Thread(
+            target=self._broadcast_routine,
+            args=(peer,),
+            name=f"mempool-bcast-{peer.id}",
+            daemon=True,
+        ).start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        peer.set(self.PEER_KEY, None)
+
+    def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
+        tx = decode_tx_message(payload)
+        # bad txs answer with a code; gossip just drops them (reference
+        # `Receive :74-86` ignores CheckTx results from peers)
+        self.mempool.check_tx(tx)
+
+    def _broadcast_routine(self, peer: Peer) -> None:
+        """Reference `broadcastTxRoutine :114-152`. The cursor is the
+        mempool's intake counter (commit-time compaction renumbers list
+        positions but never counters)."""
+        cursor = 0
+        while self._running and peer.get(self.PEER_KEY):
+            for counter, tx in self.mempool.get_after(
+                cursor, wait=True, timeout=0.2
+            ):
+                peer.send(MEMPOOL_CHANNEL, encode_tx_message(tx))
+                cursor = max(cursor, counter)

@@ -1,0 +1,5 @@
+"""Node composition root (reference `node/node.go:75-353`)."""
+
+from tendermint_tpu.node.node import Node
+
+__all__ = ["Node"]

@@ -1,0 +1,213 @@
+"""Node: assemble every subsystem from config + genesis and run it
+(reference `node/node.go:114-353`).
+
+Composition order mirrors the reference: DBs -> priv validator ->
+genesis -> state -> ABCI conns + handshake -> mempool -> reactors
+(blockchain, consensus, mempool) -> switch -> p2p listener -> RPC.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from tendermint_tpu.abci.client import local_client_creator
+from tendermint_tpu.blockchain.reactor import BlockchainReactor
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config import Config
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.ticker import TimeoutTicker
+from tendermint_tpu.db.kv import DB, MemDB, SQLiteDB
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.peer import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.tcp import TcpListener, dial
+from tendermint_tpu.rpc.core import make_routes
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.state.state import State, load_state, make_genesis_state
+from tendermint_tpu.state.txindex import KVTxIndexer
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.genesis import GenesisDoc
+from tendermint_tpu.types.priv_validator import PrivValidatorFS
+
+
+class Node:
+    """One full node. `start()` brings up p2p + RPC + (fast-sync then)
+    consensus; `stop()` tears everything down."""
+
+    def __init__(
+        self,
+        config: Config,
+        genesis: GenesisDoc | None = None,
+        priv_validator=None,
+        app=None,
+        db_provider=None,
+        verifier=None,
+    ) -> None:
+        self.config = config
+        cfg = config
+
+        def _db(name: str) -> DB:
+            if db_provider is not None:
+                return db_provider(name)
+            return SQLiteDB(cfg.db_path(name))
+
+        # genesis + priv validator (reference LoadOrGen + genesisDocProvider)
+        self.genesis = (
+            genesis
+            if genesis is not None
+            else GenesisDoc.from_file(cfg.genesis_path())
+        )
+        self.priv_validator = (
+            priv_validator
+            if priv_validator is not None
+            else PrivValidatorFS.load_or_gen(cfg.priv_validator_path())
+        )
+        self.node_id = self.priv_validator.address.hex()
+
+        # state + stores
+        self.state_db = _db("state")
+        st = load_state(self.state_db)
+        if st is None:
+            st = make_genesis_state(self.state_db, self.genesis)
+            st.save()
+        self.state: State = st
+        self.block_store = BlockStore(_db("blockstore"))
+
+        # app conns + crash-recovery handshake (reference NewAppConns +
+        # Handshaker; in-proc app — socket/gRPC transports are the
+        # remaining proxy gap)
+        if app is None:
+            from tendermint_tpu.abci.apps import KVStoreApp
+
+            app = KVStoreApp()
+        self.app = app
+        self.app_conns = local_client_creator(app)()
+        Handshaker(self.state, self.block_store, verifier=verifier).handshake(
+            self.app_conns
+        )
+
+        # mempool + tx index
+        self.mempool = Mempool(
+            self.app_conns.mempool,
+            height=self.state.last_block_height,
+            cache_size=cfg.mempool.cache_size,
+            wal_dir=cfg.mempool_wal_path() if cfg.mempool.wal_dir else None,
+            recheck=cfg.mempool.recheck,
+        )
+        self.tx_indexer = KVTxIndexer(_db("txindex"))
+        self.event_switch = ev.EventSwitch()
+
+        # fast-sync only when peers could be ahead AND we are not the
+        # solo validator (reference node.go:174-205)
+        solo = (
+            self.priv_validator is not None
+            and len(self.state.validators) == 1
+            and self.state.validators.validators[0].address
+            == self.priv_validator.address
+        )
+        fast_sync = cfg.base.fast_sync and not solo
+
+        self.consensus = ConsensusState(
+            config=cfg.consensus,
+            state=self.state,
+            app_conn=self.app_conns.consensus,
+            block_store=self.block_store,
+            mempool=self.mempool,
+            priv_validator=self.priv_validator,
+            event_switch=self.event_switch,
+            wal_path=cfg.wal_path(),
+            ticker=TimeoutTicker(),
+            verifier=verifier,
+            tx_indexer=self.tx_indexer,
+        )
+        self.consensus_reactor = ConsensusReactor(self.consensus, fast_sync=fast_sync)
+        self.blockchain_reactor = BlockchainReactor(
+            state=self.state,
+            store=self.block_store,
+            app_conn=self.app_conns.consensus,
+            fast_sync=fast_sync,
+            on_caught_up=self._on_caught_up,
+            verifier=verifier,
+            tx_indexer=self.tx_indexer,
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, broadcast=cfg.mempool.broadcast
+        )
+
+        self.switch = Switch(
+            NodeInfo(
+                node_id=self.node_id,
+                moniker=cfg.base.moniker,
+                chain_id=self.genesis.chain_id,
+            )
+        )
+        self.switch.add_reactor("blockchain", self.blockchain_reactor)
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("mempool", self.mempool_reactor)
+
+        self.listener: TcpListener | None = None
+        self.rpc: RPCServer | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _on_caught_up(self, state) -> None:
+        """Fast-sync finished: start consensus (reference
+        `SwitchToConsensus`)."""
+        self.consensus_reactor.switch_to_consensus(state)
+
+    def start(self) -> None:
+        self.switch.start()  # reactors start; consensus starts unless fast-syncing
+        if self.config.p2p.laddr:
+            self.listener = TcpListener(self.switch, self.config.p2p.laddr)
+        if self.config.rpc.laddr:
+            self.rpc = RPCServer(make_routes(self), self.config.rpc.laddr)
+            self.rpc.start()
+        for seed in filter(None, self.config.p2p.seeds.split(",")):
+            try:
+                dial(self.switch, seed.strip())
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).warning("dial %s failed", seed)
+
+    def stop(self) -> None:
+        if self.rpc is not None:
+            self.rpc.stop()
+        if self.listener is not None:
+            self.listener.stop()
+        self.switch.stop()
+        self.mempool.close()
+
+    # -- convenience -------------------------------------------------------
+
+    @property
+    def current_state(self) -> State:
+        """The live chain state. Consensus REBINDS its state on every
+        commit (finalize copies then adopts), so `self.state` only
+        tracks fast-sync's in-place mutations — RPC must read through
+        here or it serves startup-time state forever."""
+        if self.consensus is not None and self.consensus.state is not None:
+            return self.consensus.state
+        return self.state
+
+    @property
+    def rpc_port(self) -> int:
+        return self.rpc.port if self.rpc else 0
+
+    @property
+    def p2p_port(self) -> int:
+        return self.listener.port if self.listener else 0
+
+    def wait_height(self, height: int, timeout: float = 60.0) -> None:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.block_store.height >= height:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"node did not reach height {height}")

@@ -1,0 +1,152 @@
+"""TCP transport: run the Switch over real sockets.
+
+Frames are 4-byte big-endian length-prefixed (carrying the same
+channel-multiplexed payloads as the in-memory pipe). Connecting sides
+exchange NodeInfo as the first frame (version/chain-id compat handshake
+— reference `p2p/peer.go` handshake; the reference's SecretConnection
+encryption layer is a documented gap here, acceptable for trusted
+networks / local testnets).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from tendermint_tpu.p2p.peer import NodeInfo
+from tendermint_tpu.p2p.switch import Switch
+from tendermint_tpu.p2p.transport import EndpointClosed
+
+_MAX_FRAME = 8 * 1024 * 1024
+
+
+class TcpEndpoint:
+    """transport.Endpoint over a connected socket."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._wlock = threading.Lock()
+        self._closed = threading.Event()
+        sock.settimeout(None)
+
+    def send(self, data: bytes, timeout: float = 10.0) -> bool:
+        if self._closed.is_set():
+            raise EndpointClosed
+        try:
+            with self._wlock:
+                self._sock.sendall(struct.pack(">I", len(data)) + data)
+            return True
+        except OSError as e:
+            self.close()
+            raise EndpointClosed from e
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise EndpointClosed
+            buf += chunk
+        return buf
+
+    def recv(self, timeout: float | None = None) -> bytes:
+        if self._closed.is_set():
+            raise EndpointClosed
+        try:
+            self._sock.settimeout(timeout)
+            (length,) = struct.unpack(">I", self._read_exact(4))
+            if length > _MAX_FRAME:
+                raise EndpointClosed
+            return self._read_exact(length)
+        except socket.timeout as e:
+            raise TimeoutError from e
+        except OSError as e:
+            self.close()
+            raise EndpointClosed from e
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+def parse_laddr(laddr: str) -> tuple[str, int]:
+    """'tcp://host:port' -> (host, port)."""
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "0.0.0.0", int(port)
+
+
+class TcpListener:
+    """Accept loop: handshake NodeInfo, hand peers to the switch."""
+
+    def __init__(self, switch: Switch, laddr: str) -> None:
+        self.switch = switch
+        host, port = parse_laddr(laddr)
+        self._srv = socket.create_server((host, port), reuse_port=False)
+        self.addr = self._srv.getsockname()  # actual (host, port) after bind
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="p2p-accept", daemon=True
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.addr[1]
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handshake, args=(sock, False), daemon=True
+            ).start()
+
+    def _handshake(self, sock: socket.socket, outbound: bool) -> None:
+        ep = TcpEndpoint(sock)
+        try:
+            ep.send(self.switch.node_info.encode())
+            remote = NodeInfo.decode(ep.recv(timeout=10.0))
+            self.switch.add_peer_endpoint(remote, ep, outbound=outbound)
+        except Exception:
+            ep.close()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def dial(switch: Switch, addr: str, timeout: float = 10.0):
+    """Connect out to host:port (or tcp://host:port) and add the peer."""
+    host, port = parse_laddr(addr)
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    ep = TcpEndpoint(sock)
+    try:
+        ep.send(switch.node_info.encode())
+        remote = NodeInfo.decode(ep.recv(timeout=timeout))
+        return switch.add_peer_endpoint(remote, ep, outbound=True)
+    except Exception:
+        ep.close()
+        raise

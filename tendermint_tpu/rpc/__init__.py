@@ -1,0 +1,6 @@
+"""JSON-RPC API layer (reference `rpc/lib` + `rpc/core`)."""
+
+from tendermint_tpu.rpc.server import RPCServer
+from tendermint_tpu.rpc.core import make_routes
+
+__all__ = ["RPCServer", "make_routes"]

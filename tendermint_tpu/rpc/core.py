@@ -1,0 +1,280 @@
+"""RPC core handlers wired to node internals (reference
+`rpc/core/routes.go:8-45` + per-file handlers).
+
+`make_routes(node)` builds the route table from a composed Node;
+responses are hex-encoded JSON dicts mirroring the reference's
+result types.
+"""
+
+from __future__ import annotations
+
+import queue
+import time
+
+from tendermint_tpu.rpc.server import RPCError
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.tx import tx_hash
+
+BROADCAST_TX_COMMIT_TIMEOUT_S = 60.0  # reference waits up to 120s
+
+
+def _header_json(header) -> dict:
+    return {
+        "chain_id": header.chain_id,
+        "height": header.height,
+        "time": header.time,
+        "num_txs": header.num_txs,
+        "last_block_id": {
+            "hash": header.last_block_id.hash.hex(),
+            "parts": {
+                "total": header.last_block_id.parts_header.total,
+                "hash": header.last_block_id.parts_header.hash.hex(),
+            },
+        },
+        "last_commit_hash": header.last_commit_hash.hex(),
+        "data_hash": header.data_hash.hex(),
+        "validators_hash": header.validators_hash.hex(),
+        "app_hash": header.app_hash.hex(),
+        "hash": header.hash().hex(),
+    }
+
+
+def _block_json(block) -> dict:
+    return {
+        "header": _header_json(block.header),
+        "txs": [bytes(tx).hex() for tx in block.data.txs],
+        "last_commit": {
+            "block_id": block.last_commit.block_id.hash.hex()
+            if block.last_commit.precommits
+            else "",
+            "precommits": sum(
+                1 for p in block.last_commit.precommits if p is not None
+            ),
+        },
+    }
+
+
+def make_routes(node) -> dict:
+    """Route table (reference `rpc/core/routes.go:8-34`)."""
+
+    def status() -> dict:
+        rs = node.consensus.get_round_state() if node.consensus else None
+        latest = node.block_store.load_block_meta(node.block_store.height)
+        return {
+            "node_info": {
+                "id": node.node_id,
+                "moniker": node.config.base.moniker,
+                "chain_id": node.genesis.chain_id,
+            },
+            "sync_info": {
+                "latest_block_height": node.block_store.height,
+                "latest_block_hash": latest.block_id.hash.hex() if latest else "",
+                "latest_app_hash": node.current_state.app_hash.hex(),
+                "catching_up": node.blockchain_reactor.fast_sync
+                if node.blockchain_reactor
+                else False,
+            },
+            "validator_info": {
+                "address": node.priv_validator.address.hex()
+                if node.priv_validator
+                else "",
+                "voting_power": next(
+                    (
+                        v.voting_power
+                        for v in node.current_state.validators
+                        if node.priv_validator
+                        and v.address == node.priv_validator.address
+                    ),
+                    0,
+                ),
+            },
+            "consensus_state": {
+                "height": rs.height if rs else 0,
+                "round": rs.round if rs else 0,
+                "step": rs.step if rs else 0,
+            },
+        }
+
+    def net_info() -> dict:
+        peers = node.switch.peers() if node.switch else []
+        return {
+            "n_peers": len(peers),
+            "peers": [
+                {"id": p.id, "moniker": p.node_info.moniker, "outbound": p.outbound}
+                for p in peers
+            ],
+        }
+
+    def block(height: int) -> dict:
+        b = node.block_store.load_block(int(height))
+        if b is None:
+            raise RPCError(-32000, f"no block at height {height}")
+        return {"block": _block_json(b)}
+
+    def blockchain(min_height: int = 1, max_height: int = 0) -> dict:
+        top = node.block_store.height
+        max_h = int(max_height) or top
+        max_h = min(max_h, top)
+        min_h = max(int(min_height), max(1, max_h - 20 + 1))
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            m = node.block_store.load_block_meta(h)
+            if m is not None:
+                metas.append(
+                    {"height": m.header.height, "hash": m.block_id.hash.hex()}
+                )
+        return {"last_height": top, "block_metas": metas}
+
+    def commit(height: int) -> dict:
+        c = node.block_store.load_block_commit(int(height))
+        seen = c is None
+        if c is None:
+            c = node.block_store.load_seen_commit(int(height))
+        if c is None:
+            raise RPCError(-32000, f"no commit for height {height}")
+        return {
+            "canonical": not seen,
+            "commit": {
+                "height": c.height(),
+                "round": c.round(),
+                "block_id": c.block_id.hash.hex(),
+                "signatures": sum(1 for p in c.precommits if p is not None),
+            },
+        }
+
+    def validators(height: int | None = None) -> dict:
+        h = int(height) if height is not None else node.current_state.last_block_height + 1
+        vs = node.current_state.load_validators(h)
+        return {
+            "block_height": h,
+            "validators": [
+                {
+                    "address": v.address.hex(),
+                    "pub_key": v.pub_key.data.hex(),
+                    "voting_power": v.voting_power,
+                }
+                for v in vs
+            ],
+        }
+
+    def dump_consensus_state() -> dict:
+        if node.consensus is None:
+            raise RPCError(-32000, "consensus not running")
+        rs = node.consensus.get_round_state()
+        return {
+            "height": rs.height,
+            "round": rs.round,
+            "step": rs.step,
+            "proposal": rs.proposal is not None,
+            "locked_round": rs.locked_round,
+            "validators": len(rs.validators),
+        }
+
+    def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
+        res = node.app_conns.query.query_sync(
+            path, bytes.fromhex(data) if data else b"", int(height), bool(prove)
+        )
+        return {
+            "code": res.code,
+            "value": res.value.hex(),
+            "log": res.log,
+            "height": res.height,
+        }
+
+    def num_unconfirmed_txs() -> dict:
+        return {"n_txs": node.mempool.size()}
+
+    def _decode_tx(tx: str) -> bytes:
+        try:
+            return bytes.fromhex(tx)
+        except ValueError as e:
+            raise RPCError(-32602, f"tx must be hex: {e}") from e
+
+    def broadcast_tx_async(tx: str) -> dict:
+        raw = _decode_tx(tx)
+        node.mempool.check_tx(raw)
+        return {"hash": tx_hash(raw).hex()}
+
+    def broadcast_tx_sync(tx: str) -> dict:
+        raw = _decode_tx(tx)
+        res = node.mempool.check_tx(raw)
+        return {
+            "code": res.code,
+            "data": res.data.hex(),
+            "log": res.log,
+            "hash": tx_hash(raw).hex(),
+        }
+
+    def broadcast_tx_commit(tx: str) -> dict:
+        """CheckTx, then wait for the tx to be committed in a block
+        (reference `rpc/core/mempool.go:149-215`)."""
+        raw = _decode_tx(tx)
+        h = tx_hash(raw)
+        got: "queue.Queue" = queue.Queue()
+        key = ev.event_tx(h)
+        listener_id = f"rpc-tx-{h.hex()[:16]}-{time.monotonic_ns()}"
+        node.event_switch.add_listener(listener_id, key, got.put)
+        try:
+            check = node.mempool.check_tx(raw)
+            if not check.is_ok:
+                return {
+                    "check_tx": {"code": check.code, "log": check.log},
+                    "deliver_tx": {},
+                    "hash": h.hex(),
+                    "height": 0,
+                }
+            try:
+                data = got.get(timeout=BROADCAST_TX_COMMIT_TIMEOUT_S)
+            except queue.Empty:
+                raise RPCError(-32000, "timed out waiting for tx commit") from None
+            return {
+                "check_tx": {"code": check.code, "log": check.log},
+                "deliver_tx": {
+                    "code": data.code,
+                    "data": data.data.hex(),
+                    "log": data.log,
+                },
+                "hash": h.hex(),
+                "height": data.height,
+            }
+        finally:
+            node.event_switch.remove_listener(listener_id)
+
+    def tx(hash: str) -> dict:
+        if node.tx_indexer is None:
+            raise RPCError(-32000, "tx indexing disabled")
+        tr = node.tx_indexer.get(bytes.fromhex(hash))
+        if tr is None:
+            raise RPCError(-32000, f"tx {hash} not found")
+        return {
+            "height": tr.height,
+            "index": tr.index,
+            "tx": tr.tx.hex(),
+            "result": {
+                "code": tr.result.code,
+                "data": tr.result.data.hex(),
+                "log": tr.result.log,
+            },
+        }
+
+    def genesis() -> dict:
+        import json as _json
+
+        return {"genesis": _json.loads(node.genesis.to_json())}
+
+    return {
+        "status": status,
+        "net_info": net_info,
+        "block": block,
+        "blockchain": blockchain,
+        "commit": commit,
+        "validators": validators,
+        "dump_consensus_state": dump_consensus_state,
+        "abci_query": abci_query,
+        "num_unconfirmed_txs": num_unconfirmed_txs,
+        "broadcast_tx_async": broadcast_tx_async,
+        "broadcast_tx_sync": broadcast_tx_sync,
+        "broadcast_tx_commit": broadcast_tx_commit,
+        "tx": tx,
+        "genesis": genesis,
+    }

@@ -1,0 +1,143 @@
+"""Minimal JSON-RPC 2.0 server: HTTP POST + GET-URI forms.
+
+Reference `rpc/lib/server/handlers.go:101` (JSON-RPC over POST) and
+`:234` (GET with query params). Handlers are plain callables registered
+by name with keyword params; results must be JSON-serializable dicts.
+WebSocket event subscription is a known gap (the event bus exists;
+transport pending).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class RPCServer:
+    def __init__(self, routes: dict, laddr: str = "tcp://127.0.0.1:46657"):
+        from tendermint_tpu.p2p.tcp import parse_laddr
+
+        self.routes = routes
+        host, port = parse_laddr(laddr)
+        handler = _make_handler(routes)
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.addr = self._httpd.server_address
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.addr[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rpc-http", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _make_handler(routes: dict):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+        def _respond(self, obj, status=200):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _call(self, req_id, method, params):
+            fn = routes.get(method)
+            if fn is None:
+                return {
+                    "jsonrpc": "2.0",
+                    "id": req_id,
+                    "error": {"code": -32601, "message": f"unknown method {method}"},
+                }
+            try:
+                result = fn(**params) if isinstance(params, dict) else fn(*params)
+                return {"jsonrpc": "2.0", "id": req_id, "result": result}
+            except RPCError as e:
+                return {
+                    "jsonrpc": "2.0",
+                    "id": req_id,
+                    "error": {"code": e.code, "message": e.message},
+                }
+            except TypeError as e:
+                return {
+                    "jsonrpc": "2.0",
+                    "id": req_id,
+                    "error": {"code": -32602, "message": f"invalid params: {e}"},
+                }
+            except Exception as e:
+                return {
+                    "jsonrpc": "2.0",
+                    "id": req_id,
+                    "error": {"code": -32603, "message": str(e)},
+                }
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+            except json.JSONDecodeError:
+                self._respond(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": None,
+                        "error": {"code": -32700, "message": "parse error"},
+                    }
+                )
+                return
+            if not isinstance(req, dict):
+                self._respond(
+                    {
+                        "jsonrpc": "2.0",
+                        "id": None,
+                        "error": {
+                            "code": -32600,
+                            "message": "request must be a JSON object",
+                        },
+                    }
+                )
+                return
+            self._respond(
+                self._call(req.get("id"), req.get("method", ""), req.get("params", {}))
+            )
+
+        def do_GET(self):
+            url = urlparse(self.path)
+            method = url.path.strip("/")
+            if method == "":
+                # route listing (reference serves an index page)
+                self._respond({"jsonrpc": "2.0", "id": -1, "result": sorted(routes)})
+                return
+            params = {}
+            for k, v in parse_qsl(url.query):
+                # keep values as strings except explicit booleans —
+                # handlers coerce numerics themselves (json.loads would
+                # mangle all-digit hex params like tx/hash/data into ints)
+                if v in ("true", "false"):
+                    params[k] = v == "true"
+                else:
+                    params[k] = v.strip('"')
+            self._respond(self._call(-1, method, params))
+
+    return Handler

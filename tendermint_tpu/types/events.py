@@ -25,6 +25,7 @@ EVENT_UNLOCK = "Unlock"
 EVENT_LOCK = "Lock"
 EVENT_RELOCK = "Relock"
 EVENT_VOTE = "Vote"
+EVENT_TX = "Tx"
 EVENT_PROPOSAL_HEARTBEAT = "ProposalHeartbeat"
 
 

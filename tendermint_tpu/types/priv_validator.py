@@ -112,16 +112,70 @@ class PrivValidator:
 
     # -- public signing API ---------------------------------------------------
 
+    def _timestamp_tolerant_cached(
+        self, kind: str, height: int, round_: int, step: int, sign_bytes: bytes
+    ) -> tuple[int, bytes] | None:
+        """If we already signed the SAME (h, r, s) payload differing only
+        in its timestamp — the crash-replay case: a restarted node
+        rebuilds the vote/proposal with a fresh clock — return the
+        cached (timestamp, signature) so the caller re-emits the
+        original artifact instead of double-signing or wedging
+        (reference checkVotesOnlyDifferByTimestamp; without this a
+        crashed solo validator can never re-vote at its in-progress
+        height and halts forever)."""
+        last = self._last
+        if (height, round_, step) != (last.height, last.round, last.step):
+            return None
+        if not last.sign_bytes or sign_bytes == last.sign_bytes:
+            return None
+        try:
+            now_doc = json.loads(sign_bytes)
+            last_doc = json.loads(last.sign_bytes)
+        except ValueError:
+            return None
+        last_ts = last_doc.get(kind, {}).get("timestamp")
+        if last_ts is None:
+            return None
+        now_doc.get(kind, {}).pop("timestamp", None)
+        last_doc.get(kind, {}).pop("timestamp", None)
+        if now_doc != last_doc:
+            return None
+        return last_ts, last.signature
+
     def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
-        sig = self._sign_and_record(
-            vote.height, vote.round, vote_to_step(vote), vote.sign_bytes(chain_id)
-        )
+        from dataclasses import replace as _replace
+
+        with self._lock:
+            step = vote_to_step(vote)
+            cached = self._timestamp_tolerant_cached(
+                "vote", vote.height, vote.round, step, vote.sign_bytes(chain_id)
+            )
+            if cached is not None:
+                ts, sig = cached
+                return _replace(vote, timestamp=ts, signature=sig)
+            sig = self._sign_and_record(
+                vote.height, vote.round, step, vote.sign_bytes(chain_id)
+            )
         return vote.with_signature(sig)
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
-        sig = self._sign_and_record(
-            proposal.height, proposal.round, STEP_PROPOSE, proposal.sign_bytes(chain_id)
-        )
+        from dataclasses import replace as _replace
+
+        with self._lock:
+            cached = self._timestamp_tolerant_cached(
+                "proposal",
+                proposal.height,
+                proposal.round,
+                STEP_PROPOSE,
+                proposal.sign_bytes(chain_id),
+            )
+            if cached is not None:
+                ts, sig = cached
+                return _replace(proposal, timestamp=ts, signature=sig)
+            sig = self._sign_and_record(
+                proposal.height, proposal.round, STEP_PROPOSE,
+                proposal.sign_bytes(chain_id),
+            )
         return proposal.with_signature(sig)
 
     def sign_heartbeat(self, chain_id: str, hb: Heartbeat) -> Heartbeat:

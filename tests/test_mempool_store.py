@@ -97,7 +97,7 @@ class TestMempool:
         mp, _ = _mempool()
         mp.check_tx(b"a=1")
         got = mp.get_after(0)
-        assert got == [b"a=1"]
+        assert got == [(1, b"a=1")]
         results = []
 
         def waiter():
@@ -107,7 +107,26 @@ class TestMempool:
         t.start()
         mp.check_tx(b"b=2")
         t.join(timeout=5)
-        assert results == [b"b=2"]
+        assert results == [(2, b"b=2")]
+
+    def test_get_after_counter_survives_commit_compaction(self):
+        # positional cursors would stall after update() compacts the
+        # list (round-3 review finding): counters must keep advancing
+        from tendermint_tpu.types.tx import Txs
+
+        mp, _ = _mempool()
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        cursor = max(c for c, _ in mp.get_after(0))
+        assert cursor == 2
+        mp.lock()
+        try:
+            mp.update(1, Txs([b"a=1", b"b=2"]))  # both committed
+        finally:
+            mp.unlock()
+        mp.check_tx(b"c=3")
+        got = mp.get_after(cursor)
+        assert got == [(3, b"c=3")]
 
 
 class TestBlockStore:

@@ -1,0 +1,196 @@
+"""Full-node composition: CLI init/testnet, solo chain over RPC,
+multi-node TCP testnet, kill -9 crash recovery (reference
+`node/node_test.go`, `cmd/`, `test/p2p/`, `test/persist/`).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config, load_config
+from tendermint_tpu.node import Node
+
+pytestmark = pytest.mark.slow
+
+
+def rpc(port, method, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=90) as resp:
+        out = json.load(resp)
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+def wait_until(pred, timeout=60.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestCLI:
+    def test_init_creates_home(self, tmp_path):
+        home = str(tmp_path / "home")
+        assert cli_main(["init", "--home", home, "--chain-id", "cli-chain"]) == 0
+        for f in ("config.toml", "genesis.json", "priv_validator.json"):
+            assert os.path.exists(os.path.join(home, f))
+        cfg = load_config(home)
+        assert cfg.base.moniker  # toml round-trips
+
+    def test_testnet_generates_wired_homes(self, tmp_path):
+        out = str(tmp_path / "net")
+        assert (
+            cli_main(
+                ["testnet", "--n", "3", "--output", out, "--starting-port", "47000"]
+            )
+            == 0
+        )
+        gens = set()
+        for i in range(3):
+            cfg = load_config(os.path.join(out, f"node{i}"))
+            assert cfg.p2p.seeds.count(":") == 2  # two peer addrs
+            with open(os.path.join(out, f"node{i}", "genesis.json")) as f:
+                gens.add(f.read())
+        assert len(gens) == 1  # identical genesis everywhere
+
+
+def _solo_node(tmp_path, fast_sync=False) -> Node:
+    home = str(tmp_path / "solo")
+    cli_main(["init", "--home", home, "--chain-id", "solo-test"])
+    cfg = Config.test_config(home)
+    cfg.base.fast_sync = fast_sync
+    node = Node(cfg)
+    node.start()
+    return node
+
+
+class TestSoloNode:
+    def test_commits_and_serves_rpc(self, tmp_path):
+        node = _solo_node(tmp_path)
+        try:
+            port = node.rpc_port
+            tx = b"rpc-key=rpc-val".hex()
+            res = rpc(port, "broadcast_tx_commit", tx=tx)
+            assert res["deliver_tx"]["code"] == 0
+            assert res["height"] >= 1
+            status = rpc(port, "status")
+            assert status["sync_info"]["latest_block_height"] >= res["height"] - 1
+            q = rpc(port, "abci_query", path="", data=b"rpc-key".hex())
+            assert bytes.fromhex(q["value"]) == b"rpc-val"
+            blk = rpc(port, "block", height=res["height"])
+            assert blk["block"]["header"]["height"] == res["height"]
+            vals = rpc(port, "validators")
+            assert len(vals["validators"]) == 1
+            found = rpc(port, "tx", hash=res["hash"])
+            assert bytes.fromhex(found["tx"]) == b"rpc-key=rpc-val"
+        finally:
+            node.stop()
+
+
+class TestTcpTestnet:
+    def test_four_nodes_over_tcp(self, tmp_path):
+        out = str(tmp_path / "net")
+        cli_main(
+            ["testnet", "--n", "4", "--output", out, "--starting-port", "0"]
+        )
+        nodes = []
+        try:
+            # start with ephemeral ports, then dial actual addresses
+            for i in range(4):
+                cfg = Config.test_config(os.path.join(out, f"node{i}"))
+                cfg.base.moniker = f"node{i}"
+                nodes.append(Node(cfg))
+            for n in nodes:
+                n.start()
+            from tendermint_tpu.p2p.tcp import dial
+
+            for i in range(4):
+                for j in range(i + 1, 4):
+                    dial(nodes[i].switch, f"127.0.0.1:{nodes[j].p2p_port}")
+            wait_until(
+                lambda: all(n.block_store.height >= 3 for n in nodes),
+                timeout=90,
+                msg="testnet commits over TCP",
+            )
+            h1 = {n.block_store.load_block(1).hash() for n in nodes}
+            assert len(h1) == 1
+            # tx gossip: submit via node0's RPC, committed chain-wide
+            res = rpc(nodes[0].rpc_port, "broadcast_tx_commit", tx=b"a=b".hex())
+            assert res["deliver_tx"]["code"] == 0
+            info = rpc(nodes[3].rpc_port, "net_info")
+            assert info["n_peers"] == 3
+        finally:
+            for n in nodes:
+                n.stop()
+
+
+class TestCrashRecovery:
+    def test_kill9_and_restart_resumes_chain(self, tmp_path):
+        home = str(tmp_path / "crash")
+        cli_main(["init", "--home", home, "--chain-id", "crash-test"])
+
+        script = (
+            "import sys; sys.path.insert(0, %r); "
+            "from tendermint_tpu.config import Config; "
+            "from tendermint_tpu.node import Node; "
+            "cfg = Config.test_config(%r); cfg.base.fast_sync = False; "
+            "cfg.rpc.laddr = 'tcp://127.0.0.1:%%d' %% int(sys.argv[1]); "
+            "n = Node(cfg); n.start(); print('UP', flush=True); "
+            "import time; time.sleep(600)"
+        ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), home)
+
+        errlog = open(str(tmp_path / "node_stderr.log"), "ab")
+
+        def run(port):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            return subprocess.Popen(
+                [sys.executable, "-c", script, str(port)],
+                stdout=subprocess.PIPE,
+                stderr=errlog,
+                env=env,
+            )
+
+        import random
+
+        port = random.randint(47100, 47900)
+        proc = run(port)
+        try:
+            assert proc.stdout.readline().strip() == b"UP"
+            wait_until(
+                lambda: rpc(port, "status")["sync_info"]["latest_block_height"] >= 2,
+                timeout=60,
+                msg="first run commits",
+            )
+            h_before = rpc(port, "status")["sync_info"]["latest_block_height"]
+        finally:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+
+        proc = run(port)
+        try:
+            assert proc.stdout.readline().strip() == b"UP"
+            wait_until(
+                lambda: rpc(port, "status")["sync_info"]["latest_block_height"]
+                >= h_before + 2,
+                timeout=60,
+                msg="chain resumes past pre-crash height",
+            )
+        finally:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()

@@ -82,3 +82,21 @@ def test_load_or_gen_idempotent(tmp_path):
     a = PrivValidatorFS.load_or_gen(path)
     b = PrivValidatorFS.load_or_gen(path)
     assert a.address == b.address
+
+
+def test_resign_differing_only_by_timestamp_reuses_cached_vote():
+    # crash-replay: the restarted node rebuilds the same vote with a
+    # fresh clock — must get the ORIGINAL timestamp+signature back, not
+    # an ErrDoubleSign wedge (reference checkVotesOnlyDifferByTimestamp)
+    pv = PrivValidator(PrivKey(b"\x05" * 32))
+    bid = make_block_id()
+    v1 = pv.sign_vote(CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PRECOMMIT, bid, ts=1000))
+    v2 = pv.sign_vote(CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PRECOMMIT, bid, ts=9999))
+    assert v2.timestamp == 1000  # cached artifact, not a new signature
+    assert v2.signature == v1.signature
+    assert pv.pub_key.verify(v2.sign_bytes(CHAIN_ID), v2.signature)
+    # a DIFFERENT block at the same HRS is still refused
+    with pytest.raises(ErrDoubleSign):
+        pv.sign_vote(
+            CHAIN_ID, mk_vote(pv, 2, 0, VOTE_TYPE_PRECOMMIT, make_block_id(b"other"))
+        )
