@@ -1,0 +1,283 @@
+"""POL lock/unlock safety cases + halt + WAL-prefix crash recovery.
+
+Ports the reference's consensus safety proofs: TestLockPOLSafety1/2
+(`consensus/state_test.go:701,822`), conflicting-vote tolerance
+(`:917`), TestHalt1 (`:997`), and replay-from-every-WAL-prefix
+(`consensus/replay_test.go:55-63`).
+"""
+
+import os
+import struct
+import time
+
+import pytest
+
+from tendermint_tpu.blockchain import BlockStore
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.ticker import TimeoutTicker
+from tendermint_tpu.db.kv import MemDB
+from tendermint_tpu.types import events as ev
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.part_set import PartSetHeader
+from tendermint_tpu.types.proposal import Proposal
+from tendermint_tpu.types.tx import Txs
+from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE
+
+from tests.test_consensus import CHAIN, Fixture
+
+NIL = BlockID(b"", PartSetHeader.zero())
+
+
+def wait_round(f, round_, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if f.cs.get_round_state().round >= round_:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"never reached round {round_}")
+
+
+def inject_late_votes(f, type_, block_id, indices, round_):
+    """Inject votes for an OLD round: signed via the raw signer since
+    the honest double-sign guard (correctly) refuses round regressions."""
+    from tests.helpers import byzantine_signed_vote
+
+    for i in indices:
+        vote = byzantine_signed_vote(
+            f.privs[i], i, f.cs.height, round_, type_, block_id, CHAIN
+        )
+        f.cs.add_vote(vote, peer_id=f"late{i}")
+
+
+def wait_own_prevote(f, round_, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pvs = f.cs.votes.prevotes(round_)
+        pv = pvs.get_by_address(f.privs[0].address) if pvs else None
+        if pv is not None:
+            return pv
+        time.sleep(0.01)
+    raise AssertionError(f"no own prevote in round {round_}")
+
+
+def make_alt_block(f, txs=(b"alt-tx",)):
+    """A valid-but-different block for the fixture's current height."""
+    st = f.cs.state
+    return Block.make_block(
+        height=st.last_block_height + 1,
+        chain_id=CHAIN,
+        txs=Txs(list(txs)),
+        last_commit=Commit.empty(),
+        last_block_id=st.last_block_id,
+        time=time.time_ns(),
+        validators_hash=st.validators.hash(),
+        app_hash=st.app_hash,
+    )
+
+
+def inject_proposal(f, block, round_, pol_round=-1):
+    """Craft + inject a proposal signed by the CURRENT proposer."""
+    parts = block.make_part_set()
+    prop = Proposal(
+        height=block.header.height,
+        round=round_,
+        block_parts_header=parts.header,
+        pol_round=pol_round,
+        pol_block_id=NIL if pol_round < 0 else BlockID.zero(),
+        timestamp=time.time_ns(),
+    )
+    proposer_addr = f.cs.validators.proposer.address
+    priv = next(p for p in f.privs if p.address == proposer_addr)
+    sig = priv._signer.sign(prop.sign_bytes(CHAIN))
+    f.cs.set_proposal(prop.with_signature(sig), peer_id="test")
+    for i in range(parts.total):
+        f.cs.add_proposal_block_part(
+            block.header.height, round_, parts.get_part(i), peer_id="test"
+        )
+    return BlockID(block.hash(), parts.header)
+
+
+class TestPOLSafety:
+    def test_old_polka_cannot_steal_newer_lock(self):
+        """TestLockPOLSafety2 essence: locked at round 1, a round-2
+        proposal carrying a round-0 POL for a DIFFERENT block must not
+        unlock us — we keep prevoting the round-1 lock."""
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            # round 0: our proposal B1 exists but we see NO polka for it;
+            # everyone precommits nil -> round 1
+            b1_id = f.proposal_block_id()
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, NIL, [1, 2, 3])
+            wait_round(f, 1)
+
+            # round 1: we are proposer again (accum math keeps the first
+            # address for r0 AND r1) and propose a fresh block B2; polka
+            # for B2 -> we lock B2 at round 1
+            b2_id = f.proposal_block_id()
+            assert b2_id.hash != b1_id.hash
+            f.inject_votes(VOTE_TYPE_PREVOTE, b2_id, [1, 2, 3], round_=1)
+            f.wait_event(ev.EVENT_LOCK)
+            rs = f.cs.get_round_state()
+            assert rs.locked_round == 1
+            assert rs.locked_block.hash() == b2_id.hash
+
+            # drive to round 2
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, NIL, [1, 2, 3], round_=1)
+            wait_round(f, 2)
+
+            # round 2: the adversary reveals an OLD round-0 polka for B1
+            # and proposes a competing block claiming that stale POL
+            inject_late_votes(f, VOTE_TYPE_PREVOTE, b1_id, [1, 2, 3], round_=0)
+            alt = make_alt_block(f, txs=(b"other-branch",))
+            inject_proposal(f, alt, round_=2, pol_round=0)
+
+            # our round-2 prevote must be the LOCKED block, not the
+            # proposal with the stale POL
+            pv = wait_own_prevote(f, 2)
+            assert pv.block_id.hash == b2_id.hash, "lock was stolen by old POL"
+            rs = f.cs.get_round_state()
+            assert rs.locked_round == 1
+            assert rs.locked_block.hash() == b2_id.hash
+        finally:
+            f.stop()
+
+    def test_late_old_polka_does_not_create_lock(self):
+        """TestLockPOLSafety1 essence: we never saw the round-0 polka and
+        precommitted nil; when those round-0 prevotes arrive AFTER we
+        moved to round 1, no retroactive lock may form."""
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            b1_id = f.proposal_block_id()
+            # round 0 passes with nil precommits (polka withheld from us)
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, NIL, [1, 2, 3])
+            wait_round(f, 1)
+            assert f.cs.get_round_state().locked_block is None
+
+            # the old round-0 polka for B1 arrives late
+            inject_late_votes(f, VOTE_TYPE_PREVOTE, b1_id, [1, 2, 3], round_=0)
+            time.sleep(0.3)  # give the loop time to (wrongly) react
+            rs = f.cs.get_round_state()
+            assert rs.locked_block is None and rs.locked_round == -1
+        finally:
+            f.stop()
+
+    def test_conflicting_votes_tolerated_first_vote_wins(self):
+        """Slashing-detection setup (`state_test.go:917`): equivocating
+        prevotes from one validator must not crash consensus; the first
+        vote is retained."""
+        from tests.helpers import byzantine_signed_vote, make_block_id
+
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            bid = f.proposal_block_id()
+            other = make_block_id(b"equivocation-target")
+            v1 = byzantine_signed_vote(
+                f.privs[1], 1, 1, 0, VOTE_TYPE_PREVOTE, bid, CHAIN
+            )
+            v2 = byzantine_signed_vote(
+                f.privs[1], 1, 1, 0, VOTE_TYPE_PREVOTE, other, CHAIN
+            )
+            f.cs.add_vote(v1, peer_id="byz")
+            f.cs.add_vote(v2, peer_id="byz")
+            time.sleep(0.3)
+            assert f.cs.fatal_error is None  # bad peer input never halts
+            kept = f.cs.votes.prevotes(0).get_by_address(f.privs[1].address)
+            assert kept is not None and kept.block_id.hash == bid.hash
+        finally:
+            f.stop()
+
+    def test_halt_recovers_via_late_round0_precommit(self):
+        """TestHalt1 essence: round 0 ends without visible quorum (2 B,
+        1 nil, 1 withheld); after we move to round 1, the withheld
+        round-0 precommit for B arrives -> +2/3 at round 0 -> commit."""
+        f = Fixture(n_vals=4, real_ticker=True)
+        try:
+            f.cs.start()
+            bid = f.proposal_block_id()
+            # polka: we lock + precommit B
+            f.inject_votes(VOTE_TYPE_PREVOTE, bid, [1, 2, 3])
+            f.wait_event(ev.EVENT_LOCK)
+            # only val1 precommits B with us; val2 nil; val3 withheld
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, bid, [1])
+            f.inject_votes(VOTE_TYPE_PRECOMMIT, NIL, [2])
+            wait_round(f, 1)  # precommit-wait timeout fires
+            assert f.cs.get_round_state().height == 1
+            # withheld round-0 precommit arrives late -> commit height 1
+            inject_late_votes(f, VOTE_TYPE_PRECOMMIT, bid, [3], round_=0)
+            blk = f.wait_height(1)
+            assert blk.header.height == 1
+        finally:
+            f.stop()
+
+
+def _wal_record_offsets(path: str) -> list[int]:
+    """Byte offsets of every record boundary (after each record)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    offsets, off = [], 0
+    while off + 8 <= len(data):
+        _, length = struct.unpack_from(">II", data, off)
+        if off + 8 + length > len(data):
+            break
+        off += 8 + length
+        offsets.append(off)
+    return offsets
+
+
+def _snapshot_db(db: MemDB) -> dict:
+    return dict(db._data)
+
+
+def _restore_db(snapshot: dict) -> MemDB:
+    db = MemDB()
+    db._data.update(snapshot)
+    return db
+
+
+@pytest.mark.slow
+class TestWALPrefixReplay:
+    def test_restart_from_every_wal_prefix(self, tmp_path):
+        """Reference `consensus/replay_test.go:55-63`: a node must
+        recover from a crash at ANY WAL position. Run a solo validator
+        a few heights, then restart from the state/store/WAL as they
+        were, with the WAL truncated at every record boundary."""
+        wal_path = str(tmp_path / "cs.wal")
+        db, store_db = MemDB(), MemDB()
+        f = Fixture(
+            n_vals=1, wal_path=wal_path, db=db, store_db=store_db, real_ticker=True
+        )
+        f.cs.start()
+        f.wait_height(3)
+        f.stop()
+
+        with open(wal_path, "rb") as fh:
+            wal_bytes = fh.read()
+        offsets = _wal_record_offsets(wal_path)
+        assert len(offsets) > 10
+        db_snap, store_snap = _snapshot_db(db), _snapshot_db(store_db)
+        base_height = BlockStore(_restore_db(store_snap)).height
+
+        # every record boundary + a mid-record torn write
+        cuts = offsets + [offsets[-1] - 3]
+        for cut in cuts:
+            trunc = str(tmp_path / f"wal-{cut}.wal")
+            with open(trunc, "wb") as fh:
+                fh.write(wal_bytes[:cut])
+            f2 = Fixture(
+                n_vals=1,
+                wal_path=trunc,
+                db=_restore_db(db_snap),
+                store_db=_restore_db(store_snap),
+                real_ticker=True,
+            )
+            try:
+                f2.cs.start()
+                assert f2.cs.fatal_error is None
+                f2.wait_height(base_height + 1, timeout=20)
+            finally:
+                f2.stop()
