@@ -119,6 +119,73 @@ def _cmd_version(args) -> int:
     return 0
 
 
+def _cmd_wal2json(args) -> int:
+    """Dump a consensus WAL as JSON lines (reference
+    `scripts/wal2json/main.go:19-50`)."""
+    import json as _json
+
+    from tendermint_tpu.consensus.wal import (
+        WAL,
+        EndHeightMessage,
+        MsgRecord,
+        RoundStateRecord,
+        TimeoutRecord,
+    )
+
+    for rec in WAL.iter_records(args.wal):
+        if isinstance(rec, EndHeightMessage):
+            out = {"type": "end_height", "height": rec.height}
+        elif isinstance(rec, RoundStateRecord):
+            out = {
+                "type": "round_state",
+                "height": rec.height,
+                "round": rec.round,
+                "step": rec.step,
+            }
+        elif isinstance(rec, TimeoutRecord):
+            out = {
+                "type": "timeout",
+                "height": rec.height,
+                "round": rec.round,
+                "step": rec.step,
+                "duration": rec.duration,
+            }
+        elif isinstance(rec, MsgRecord):
+            m = rec.msg
+            out = {
+                "type": "msg",
+                "peer": rec.peer_id,
+                "msg": type(m).__name__ if not isinstance(m, tuple) else "BlockPart",
+            }
+            if hasattr(m, "height"):
+                out["height"] = m.height
+        else:
+            out = {"type": type(rec).__name__}
+        print(_json.dumps(out))
+    return 0
+
+
+def _cmd_cut_wal_until(args) -> int:
+    """Truncate a WAL just before the first record at/after `height`
+    (reference `scripts/cutWALUntil/main.go` — builds crash fixtures)."""
+    from tendermint_tpu.consensus.wal import WAL
+
+    with open(args.wal, "rb") as f:
+        data = f.read()
+    cut = len(data)
+    for off, rec in WAL.iter_records_with_offsets(args.wal):
+        rec_height = getattr(rec, "height", None)
+        if rec_height is None:
+            rec_height = getattr(getattr(rec, "msg", None), "height", None)
+        if rec_height is not None and rec_height >= args.height:
+            cut = off
+            break
+    with open(args.output, "wb") as f:
+        f.write(data[:cut])
+    print(f"wrote {cut} of {len(data)} bytes to {args.output}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="tendermint_tpu", description="TPU-native BFT consensus node"
@@ -153,6 +220,18 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("version", help="print the version")
     p.set_defaults(fn=_cmd_version)
+
+    p = sub.add_parser("wal2json", help="dump a consensus WAL as JSON lines")
+    p.add_argument("wal")
+    p.set_defaults(fn=_cmd_wal2json)
+
+    p = sub.add_parser(
+        "cut_wal_until", help="truncate a WAL before the given height"
+    )
+    p.add_argument("wal")
+    p.add_argument("height", type=int)
+    p.add_argument("output")
+    p.set_defaults(fn=_cmd_cut_wal_until)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -43,6 +43,7 @@ class P2PConfig:
     laddr: str = "tcp://0.0.0.0:46656"
     seeds: str = ""  # comma-separated host:port
     persistent_peers: str = ""
+    secret_connections: bool = True  # X25519+AEAD STS on every peer link
     max_num_peers: int = 50
     send_rate: int = 512000  # bytes/s (flow limits live in MConnection)
     recv_rate: int = 512000

@@ -149,6 +149,13 @@ class WAL:
     def iter_records(path: str) -> Iterator[object]:
         """Decode records; stops cleanly at a truncated/corrupt tail
         (a crash mid-write must not poison recovery)."""
+        for _, rec in WAL.iter_records_with_offsets(path):
+            yield rec
+
+    @staticmethod
+    def iter_records_with_offsets(path: str) -> Iterator[tuple[int, object]]:
+        """(start_offset, record) pairs — the single place that knows the
+        on-disk frame layout (WAL tooling truncates at these offsets)."""
         with open(path, "rb") as f:
             data = f.read()
         off = 0
@@ -160,9 +167,10 @@ class WAL:
             if zlib.crc32(body) & 0xFFFFFFFF != crc:
                 return  # corrupt tail
             try:
-                yield _decode_record(body)
+                rec = _decode_record(body)
             except Exception:
                 return
+            yield off, rec
             off += 8 + length
 
     @classmethod

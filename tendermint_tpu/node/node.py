@@ -159,16 +159,40 @@ class Node:
         `SwitchToConsensus`)."""
         self.consensus_reactor.switch_to_consensus(state)
 
+    @property
+    def _node_key(self):
+        """Long-lived node identity key for SecretConnection handshakes
+        (the priv validator's key — node_id is derived from it, so the
+        encrypted transport's identity check pins peers to their ids).
+        Raises rather than silently downgrading to plaintext when the
+        configured encryption has no key to run with (e.g. a remote
+        signer that never exposes the private key — set
+        p2p.secret_connections=false explicitly for that topology)."""
+        if not self.config.p2p.secret_connections:
+            return None
+        key = getattr(self.priv_validator, "_priv_key", None)
+        if key is None:
+            signer = getattr(self.priv_validator, "_signer", None)
+            key = getattr(signer, "_priv_key", None)
+        if key is None:
+            raise ValueError(
+                "p2p.secret_connections is enabled but the priv validator "
+                "exposes no private key for the transport handshake"
+            )
+        return key
+
     def start(self) -> None:
         self.switch.start()  # reactors start; consensus starts unless fast-syncing
         if self.config.p2p.laddr:
-            self.listener = TcpListener(self.switch, self.config.p2p.laddr)
+            self.listener = TcpListener(
+                self.switch, self.config.p2p.laddr, priv_key=self._node_key
+            )
         if self.config.rpc.laddr:
             self.rpc = RPCServer(make_routes(self), self.config.rpc.laddr)
             self.rpc.start()
         for seed in filter(None, self.config.p2p.seeds.split(",")):
             try:
-                dial(self.switch, seed.strip())
+                dial(self.switch, seed.strip(), priv_key=self._node_key)
             except Exception:
                 import logging
 

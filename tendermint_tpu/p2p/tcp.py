@@ -93,10 +93,16 @@ def parse_laddr(laddr: str) -> tuple[str, int]:
 
 
 class TcpListener:
-    """Accept loop: handshake NodeInfo, hand peers to the switch."""
+    """Accept loop: handshake NodeInfo, hand peers to the switch.
 
-    def __init__(self, switch: Switch, laddr: str) -> None:
+    With `priv_key` set, every connection is wrapped in a
+    SecretConnection (X25519 + transcript-signature STS, see
+    `p2p/secret.py`) before the NodeInfo exchange, and the peer's
+    claimed node_id must match its authenticated identity key."""
+
+    def __init__(self, switch: Switch, laddr: str, priv_key=None) -> None:
         self.switch = switch
+        self.priv_key = priv_key
         host, port = parse_laddr(laddr)
         self._srv = socket.create_server((host, port), reuse_port=False)
         self.addr = self._srv.getsockname()  # actual (host, port) after bind
@@ -123,8 +129,10 @@ class TcpListener:
     def _handshake(self, sock: socket.socket, outbound: bool) -> None:
         ep = TcpEndpoint(sock)
         try:
+            ep = _maybe_secure(ep, self.priv_key)
             ep.send(self.switch.node_info.encode())
             remote = NodeInfo.decode(ep.recv(timeout=10.0))
+            _check_identity(ep, remote)
             self.switch.add_peer_endpoint(remote, ep, outbound=outbound)
         except Exception:
             ep.close()
@@ -137,15 +145,36 @@ class TcpListener:
             pass
 
 
-def dial(switch: Switch, addr: str, timeout: float = 10.0):
+def _maybe_secure(ep, priv_key):
+    if priv_key is None:
+        return ep
+    from tendermint_tpu.p2p.secret import SecretEndpoint
+
+    return SecretEndpoint(ep, priv_key)
+
+
+def _check_identity(ep, remote: NodeInfo) -> None:
+    """On a secured link, the claimed node_id must be the address of the
+    authenticated identity key (impersonation check)."""
+    remote_pub = getattr(ep, "remote_pub_key", None)
+    if remote_pub is not None and remote.node_id != remote_pub.address.hex():
+        raise ValueError(
+            f"node_id {remote.node_id[:12]} != authenticated identity "
+            f"{remote_pub.address.hex()[:12]}"
+        )
+
+
+def dial(switch: Switch, addr: str, timeout: float = 10.0, priv_key=None):
     """Connect out to host:port (or tcp://host:port) and add the peer."""
     host, port = parse_laddr(addr)
     sock = socket.create_connection((host, port), timeout=timeout)
     sock.settimeout(None)
     ep = TcpEndpoint(sock)
     try:
+        ep = _maybe_secure(ep, priv_key)
         ep.send(switch.node_info.encode())
         remote = NodeInfo.decode(ep.recv(timeout=timeout))
+        _check_identity(ep, remote)
         return switch.add_peer_endpoint(remote, ep, outbound=True)
     except Exception:
         ep.close()

@@ -1,0 +1,115 @@
+"""RPC clients: HTTP and in-process Local (reference `rpc/client/`
+HTTP + Local implementing one interface, `interface.go`).
+
+Every method mirrors a route in `rpc/core.py`; both clients are
+interchangeable (the reference's test pattern) — HTTPClient speaks
+JSON-RPC 2.0 over HTTP, LocalClient calls the route table directly.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+
+
+class _ClientBase:
+    def _call(self, method: str, **params):
+        raise NotImplementedError
+
+    # -- the client interface (reference rpc/client/interface.go) ---------
+
+    def status(self):
+        return self._call("status")
+
+    def net_info(self):
+        return self._call("net_info")
+
+    def block(self, height: int):
+        return self._call("block", height=height)
+
+    def blockchain(self, min_height: int = 1, max_height: int = 0):
+        return self._call("blockchain", min_height=min_height, max_height=max_height)
+
+    def commit(self, height: int):
+        return self._call("commit", height=height)
+
+    def validators(self, height: int | None = None):
+        if height is None:
+            return self._call("validators")
+        return self._call("validators", height=height)
+
+    def dump_consensus_state(self):
+        return self._call("dump_consensus_state")
+
+    def abci_query(self, path: str = "", data: bytes = b"", height: int = 0, prove: bool = False):
+        return self._call(
+            "abci_query", path=path, data=data.hex(), height=height, prove=prove
+        )
+
+    def num_unconfirmed_txs(self):
+        return self._call("num_unconfirmed_txs")
+
+    def genesis(self):
+        return self._call("genesis")
+
+    def tx(self, tx_hash: bytes):
+        return self._call("tx", hash=tx_hash.hex())
+
+    def broadcast_tx_async(self, tx: bytes):
+        return self._call("broadcast_tx_async", tx=tx.hex())
+
+    def broadcast_tx_sync(self, tx: bytes):
+        return self._call("broadcast_tx_sync", tx=tx.hex())
+
+    def broadcast_tx_commit(self, tx: bytes):
+        return self._call("broadcast_tx_commit", tx=tx.hex())
+
+
+class HTTPClient(_ClientBase):
+    """JSON-RPC 2.0 over HTTP (reference `rpc/client/httpclient.go`)."""
+
+    def __init__(self, address: str, timeout: float = 90.0):
+        # accepts "host:port", "tcp://host:port", or "http://host:port"
+        addr = address.split("://", 1)[-1]
+        self.url = f"http://{addr}/"
+        self.timeout = timeout
+        self._id = 0
+
+    def _call(self, method: str, **params):
+        self._id += 1
+        req = urllib.request.Request(
+            self.url,
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            out = json.load(resp)
+        if "error" in out:
+            raise RPCClientError(out["error"]["code"], out["error"]["message"])
+        return out["result"]
+
+
+class LocalClient(_ClientBase):
+    """In-process client over a Node's route table (reference
+    `rpc/client/localclient.go` — no HTTP hop, same interface)."""
+
+    def __init__(self, node):
+        from tendermint_tpu.rpc.core import make_routes
+
+        self._routes = make_routes(node)
+
+    def _call(self, method: str, **params):
+        from tendermint_tpu.rpc.server import RPCError
+
+        try:
+            return self._routes[method](**params)
+        except RPCError as e:
+            raise RPCClientError(e.code, e.message) from e

@@ -15,6 +15,11 @@ import pytest
 from tendermint_tpu.crypto.keys import gen_priv_key
 from tendermint_tpu.ops import ed25519_kernel as ed
 
+# Device-kernel compiles dominate runtime (~minutes per bucket shape);
+# excluded from the default selection (pytest.ini addopts) — run with
+#   pytest -m kernel
+pytestmark = pytest.mark.kernel
+
 
 def _fe(x: int):
     return jnp.asarray(ed._int_to_limbs(x))[None, :]

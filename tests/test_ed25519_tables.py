@@ -16,6 +16,11 @@ from tendermint_tpu.crypto.keys import gen_priv_key
 from tendermint_tpu.ops import ed25519_kernel as ed
 from tendermint_tpu.ops import ed25519_tables as tb
 
+# Device-kernel compiles dominate runtime (~minutes per bucket shape);
+# excluded from the default selection (pytest.ini addopts) — run with
+#   pytest -m kernel
+pytestmark = pytest.mark.kernel
+
 
 def _keyed_batch(n, seed=1):
     privs = [gen_priv_key(bytes([seed + i]) * 32) for i in range(n)]

@@ -21,6 +21,11 @@ from tendermint_tpu.ops.padding import (
     pad_sha512,
 )
 
+# Device-kernel compiles dominate runtime (~minutes per bucket shape);
+# excluded from the default selection (pytest.ini addopts) — run with
+#   pytest -m kernel
+pytestmark = pytest.mark.kernel
+
 LENGTHS = [0, 1, 3, 31, 32, 55, 56, 63, 64, 65, 111, 112, 127, 128, 129, 200, 300]
 
 

@@ -122,7 +122,11 @@ class TestTcpTestnet:
 
             for i in range(4):
                 for j in range(i + 1, 4):
-                    dial(nodes[i].switch, f"127.0.0.1:{nodes[j].p2p_port}")
+                    dial(
+                        nodes[i].switch,
+                        f"127.0.0.1:{nodes[j].p2p_port}",
+                        priv_key=nodes[i]._node_key,
+                    )
             wait_until(
                 lambda: all(n.block_store.height >= 3 for n in nodes),
                 timeout=90,

@@ -1,0 +1,80 @@
+"""RPC clients (HTTP + Local) and WAL ops tools."""
+
+import json
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc.client import HTTPClient, LocalClient, RPCClientError
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def solo_node(tmp_path):
+    home = str(tmp_path / "solo")
+    cli_main(["init", "--home", home, "--chain-id", "client-test"])
+    cfg = Config.test_config(home)
+    cfg.base.fast_sync = False
+    node = Node(cfg)
+    node.start()
+    yield node
+    node.stop()
+
+
+class TestClients:
+    @pytest.mark.parametrize("kind", ["http", "local"])
+    def test_client_interface(self, solo_node, kind):
+        c = (
+            HTTPClient(f"127.0.0.1:{solo_node.rpc_port}")
+            if kind == "http"
+            else LocalClient(solo_node)
+        )
+        res = c.broadcast_tx_commit(b"ck=cv")
+        assert res["deliver_tx"]["code"] == 0
+        st = c.status()
+        assert st["sync_info"]["latest_block_height"] >= 1
+        q = c.abci_query(data=b"ck")
+        assert bytes.fromhex(q["value"]) == b"cv"
+        blk = c.block(res["height"])
+        assert blk["block"]["header"]["height"] == res["height"]
+        assert len(c.validators()["validators"]) == 1
+        assert c.net_info()["n_peers"] == 0
+        with pytest.raises(RPCClientError):
+            c.block(10_000)
+
+    def test_genesis_round_trip(self, solo_node):
+        c = LocalClient(solo_node)
+        g = c.genesis()["genesis"]
+        assert g["chain_id"] == "client-test"
+
+
+class TestWALTools:
+    def test_wal2json_and_cut(self, tmp_path, capsys, solo_node):
+        solo_node.wait_height(3)
+        wal = solo_node.config.wal_path()
+        capsys.readouterr()  # drain fixture-setup output (init message)
+        assert cli_main(["wal2json", wal]) == 0
+        lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        kinds = {rec["type"] for rec in lines}
+        assert "end_height" in kinds and "msg" in kinds
+        heights = [r["height"] for r in lines if r["type"] == "end_height"]
+        assert max(heights) >= 2
+
+        out = str(tmp_path / "cut.wal")
+        assert cli_main(["cut_wal_until", wal, "2", out]) == 0
+        capsys.readouterr()
+        assert cli_main(["wal2json", out]) == 0
+        cut_lines = [
+            json.loads(line)
+            for line in capsys.readouterr().out.strip().splitlines()
+        ]
+        assert all(
+            rec.get("height", 0) < 2 or rec["type"] == "round_state"
+            for rec in cut_lines
+        ), cut_lines

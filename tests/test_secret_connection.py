@@ -1,0 +1,109 @@
+"""SecretConnection: authenticated encryption on peer links
+(reference `p2p/secret_connection_test.go`)."""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKey
+from tendermint_tpu.p2p.secret import HandshakeError, SecretEndpoint
+from tendermint_tpu.p2p.transport import EndpointClosed, pipe_pair
+
+
+def _pair(key_a=None, key_b=None):
+    a, b = pipe_pair()
+    ka = key_a or PrivKey(b"\x01" * 32)
+    kb = key_b or PrivKey(b"\x02" * 32)
+    out = {}
+
+    def side_b():
+        out["b"] = SecretEndpoint(b, kb)
+
+    t = threading.Thread(target=side_b, daemon=True)
+    t.start()
+    sa = SecretEndpoint(a, ka)
+    t.join(timeout=5)
+    return sa, out["b"], ka, kb
+
+
+class TestSecretConnection:
+    def test_round_trip_and_identity(self):
+        sa, sb, ka, kb = _pair()
+        assert sa.remote_pub_key.data == kb.pub_key.data
+        assert sb.remote_pub_key.data == ka.pub_key.data
+        sa.send(b"over the wire")
+        assert sb.recv(timeout=2) == b"over the wire"
+        sb.send(b"and back")
+        assert sa.recv(timeout=2) == b"and back"
+
+    def test_many_frames_nonce_progression(self):
+        sa, sb, _, _ = _pair()
+        for i in range(50):
+            sa.send(b"frame-%d" % i)
+        for i in range(50):
+            assert sb.recv(timeout=2) == b"frame-%d" % i
+
+    def test_tampered_frame_kills_link(self):
+        # raw pipe in the middle so we can corrupt ciphertext
+        a, mid_a = pipe_pair()
+        mid_b, b = pipe_pair()
+        done = {}
+
+        def side_b():
+            done["b"] = SecretEndpoint(b, PrivKey(b"\x02" * 32))
+
+        t = threading.Thread(target=side_b, daemon=True)
+        t.start()
+
+        # relay handshake honestly, then tamper with the next frame
+        def relay(n):
+            for _ in range(n):
+                mid_b.send(mid_a.recv(timeout=5))
+
+        relay_t = threading.Thread(
+            target=lambda: relay(2), daemon=True
+        )  # eph key + auth frame
+        relay_back = threading.Thread(
+            target=lambda: [mid_a.send(mid_b.recv(timeout=5)) for _ in range(2)],
+            daemon=True,
+        )
+        relay_t.start()
+        relay_back.start()
+        sa = SecretEndpoint(a, PrivKey(b"\x01" * 32))
+        t.join(timeout=5)
+        sb = done["b"]
+
+        sa.send(b"legit")
+        frame = bytearray(mid_a.recv(timeout=2))
+        frame[0] ^= 0xFF
+        mid_b.send(bytes(frame))
+        with pytest.raises(EndpointClosed):
+            sb.recv(timeout=2)
+
+    def test_mitm_cannot_forge_identity(self):
+        # a MITM terminating both handshakes ends up presenting ITS key,
+        # not the victim's — identity pinning upstream catches it; here
+        # we check the transcript signature itself rejects splicing: a
+        # wrong signature in the auth frame fails the handshake
+        a, b = pipe_pair()
+
+        def bad_side():
+            from cryptography.hazmat.primitives import serialization
+            from cryptography.hazmat.primitives.asymmetric.x25519 import (
+                X25519PrivateKey,
+            )
+
+            eph = X25519PrivateKey.generate()
+            b.send(
+                eph.public_key().public_bytes(
+                    serialization.Encoding.Raw, serialization.PublicFormat.Raw
+                )
+            )
+            b.recv(timeout=5)  # peer eph
+            # send garbage instead of a valid encrypted auth frame
+            b.send(b"\x00" * 96)
+
+        t = threading.Thread(target=bad_side, daemon=True)
+        t.start()
+        with pytest.raises((HandshakeError, EndpointClosed)):
+            SecretEndpoint(a, PrivKey(b"\x01" * 32))
