@@ -94,6 +94,14 @@ class AppConns:
         self.mempool = mempool
         self.query = query
 
+    def close(self) -> None:
+        """Release transport resources (no-op for in-proc conns; the
+        socket creator's conns close their TCP links)."""
+        for conn in (self.consensus, self.mempool, self.query):
+            closer = getattr(conn, "close", None)
+            if closer is not None:
+                closer()
+
 
 ClientCreator = Callable[[], AppConns]
 

@@ -44,6 +44,7 @@ class Node:
         genesis: GenesisDoc | None = None,
         priv_validator=None,
         app=None,
+        client_creator=None,
         db_provider=None,
         verifier=None,
     ) -> None:
@@ -78,14 +79,17 @@ class Node:
         self.block_store = BlockStore(_db("blockstore"))
 
         # app conns + crash-recovery handshake (reference NewAppConns +
-        # Handshaker; in-proc app — socket/gRPC transports are the
-        # remaining proxy gap)
-        if app is None:
-            from tendermint_tpu.abci.apps import KVStoreApp
+        # Handshaker). `client_creator` selects the process boundary:
+        # in-proc (default) or `abci.socket.socket_client_creator` for
+        # an app/sidecar in its own process (reference proxy/client.go)
+        if client_creator is None:
+            if app is None:
+                from tendermint_tpu.abci.apps import KVStoreApp
 
-            app = KVStoreApp()
+                app = KVStoreApp()
+            client_creator = local_client_creator(app)
         self.app = app
-        self.app_conns = local_client_creator(app)()
+        self.app_conns = client_creator()
         Handshaker(self.state, self.block_store, verifier=verifier).handshake(
             self.app_conns
         )
@@ -170,10 +174,7 @@ class Node:
         p2p.secret_connections=false explicitly for that topology)."""
         if not self.config.p2p.secret_connections:
             return None
-        key = getattr(self.priv_validator, "_priv_key", None)
-        if key is None:
-            signer = getattr(self.priv_validator, "_signer", None)
-            key = getattr(signer, "_priv_key", None)
+        key = getattr(self.priv_validator, "node_key", None)
         if key is None:
             raise ValueError(
                 "p2p.secret_connections is enabled but the priv validator "
@@ -205,6 +206,7 @@ class Node:
             self.listener.stop()
         self.switch.stop()
         self.mempool.close()
+        self.app_conns.close()
 
     # -- convenience -------------------------------------------------------
 

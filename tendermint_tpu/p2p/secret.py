@@ -106,11 +106,16 @@ class SecretEndpoint:
         with self._send_lock:
             # the wire write stays INSIDE the lock: frames must hit the
             # transport in nonce order or the receiver's counter
-            # desyncs and the AEAD check kills the link
+            # desyncs and the AEAD check kills the link. The counter
+            # advances ONLY on a successful write — a backpressure drop
+            # (inner send returning False) must not burn a nonce, or
+            # the very next frame kills the connection.
             nonce = self._nonce(self._send_nonce)
-            self._send_nonce += 1
             sealed = self._send_key.encrypt(nonce, data, None)
-            return self._inner.send(sealed, timeout)
+            ok = self._inner.send(sealed, timeout)
+            if ok:
+                self._send_nonce += 1
+            return ok
 
     def recv(self, timeout: float | None = None) -> bytes:
         sealed = self._inner.recv(timeout)

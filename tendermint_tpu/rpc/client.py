@@ -107,9 +107,18 @@ class LocalClient(_ClientBase):
         self._routes = make_routes(node)
 
     def _call(self, method: str, **params):
+        # mirror the HTTP server's error mapping so the two clients are
+        # genuinely interchangeable (same except-clauses work for both)
         from tendermint_tpu.rpc.server import RPCError
 
+        fn = self._routes.get(method)
+        if fn is None:
+            raise RPCClientError(-32601, f"unknown method {method}")
         try:
-            return self._routes[method](**params)
+            return fn(**params)
         except RPCError as e:
             raise RPCClientError(e.code, e.message) from e
+        except TypeError as e:
+            raise RPCClientError(-32602, f"invalid params: {e}") from e
+        except Exception as e:
+            raise RPCClientError(-32603, str(e)) from e

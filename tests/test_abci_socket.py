@@ -1,0 +1,71 @@
+"""Remote ABCI over sockets: the process boundary (reference
+`proxy/client.go` remote creators + `test/app/*_test.sh`)."""
+
+import pytest
+
+from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.socket import ABCISocketServer, socket_client_creator
+from tendermint_tpu.abci.types import Validator as ABCIValidator
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config
+from tendermint_tpu.node import Node
+from tendermint_tpu.rpc.client import LocalClient
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def served_app():
+    app = KVStoreApp()
+    srv = ABCISocketServer(app, "tcp://127.0.0.1:0")
+    yield app, f"127.0.0.1:{srv.port}"
+    srv.stop()
+
+
+class TestSocketProxy:
+    def test_three_connections_round_trip(self, served_app):
+        app, addr = served_app
+        conns = socket_client_creator(addr)()
+        assert conns.query.echo_sync("ping") == "ping"
+        info = conns.query.info_sync()
+        assert info.last_block_height == 0
+
+        assert conns.mempool.check_tx_async(b"k=v").is_ok
+        conns.mempool.flush_sync()
+
+        conns.consensus.init_chain_sync(
+            [ABCIValidator(pub_key=b"\x01" * 32, power=10)]
+        )
+        from tendermint_tpu.types.block import Header
+        from tendermint_tpu.types.block_id import BlockID
+
+        header = Header(
+            chain_id="sock", height=1, time=1, num_txs=1,
+            last_block_id=BlockID.zero(), validators_hash=b"\x02" * 32,
+        )
+        conns.consensus.begin_block_sync(b"\xaa" * 32, header)
+        assert conns.consensus.deliver_tx_async(b"k=v").is_ok
+        assert conns.consensus.end_block_sync(1) == []
+        commit = conns.consensus.commit_sync()
+        assert commit.is_ok and commit.data  # app hash advanced
+
+        q = conns.query.query_sync("", b"k")
+        assert q.value == b"v"
+
+    def test_node_runs_against_remote_app(self, served_app, tmp_path):
+        _, addr = served_app
+        home = str(tmp_path / "remote-app-node")
+        cli_main(["init", "--home", home, "--chain-id", "remote-abci"])
+        cfg = Config.test_config(home)
+        cfg.base.fast_sync = False
+        node = Node(cfg, client_creator=socket_client_creator(addr))
+        node.start()
+        try:
+            c = LocalClient(node)
+            res = c.broadcast_tx_commit(b"remote=yes")
+            assert res["deliver_tx"]["code"] == 0
+            q = c.abci_query(data=b"remote")
+            assert bytes.fromhex(q["value"]) == b"yes"
+            assert c.status()["sync_info"]["latest_block_height"] >= 1
+        finally:
+            node.stop()
