@@ -66,6 +66,7 @@ class ABCISocketServer:
         host, port = parse_laddr(laddr)
         self._srv = socket.create_server((host, port))
         self.addr = self._srv.getsockname()
+        self._conns: list[TcpEndpoint] = []
         self._running = True
         threading.Thread(target=self._accept_loop, name="abci-accept", daemon=True).start()
 
@@ -79,6 +80,8 @@ class ABCISocketServer:
             self._srv.close()
         except OSError:
             pass
+        for ep in list(self._conns):
+            ep.close()  # unblocks serve threads parked in recv
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -94,6 +97,7 @@ class ABCISocketServer:
         # same length-prefixed framing as the p2p transport (one frame
         # codec to maintain — TcpEndpoint)
         ep = TcpEndpoint(sock)
+        self._conns.append(ep)
         try:
             while self._running:
                 ep.send(self._handle(ep.recv()))
@@ -101,6 +105,10 @@ class ABCISocketServer:
             pass
         finally:
             ep.close()
+            try:
+                self._conns.remove(ep)
+            except ValueError:
+                pass
 
     def _handle(self, req: bytes) -> bytes:
         r = Reader(req)

@@ -41,10 +41,16 @@ class P2PConfig:
     """Reference `config/config.go:199-256`."""
 
     laddr: str = "tcp://0.0.0.0:46656"
+    # dialable address advertised to peers via PEX; REQUIRED for
+    # multi-machine deployments when binding 0.0.0.0 (loopback is only
+    # inferred for single-host setups)
+    external_address: str = ""
     seeds: str = ""  # comma-separated host:port
     persistent_peers: str = ""
     secret_connections: bool = True  # X25519+AEAD STS on every peer link
+    pex: bool = True  # peer-exchange discovery (addrbook + PEX reactor)
     max_num_peers: int = 50
+    pex_ensure_interval_s: float = 30.0  # reference ensurePeersPeriod
     send_rate: int = 512000  # bytes/s (flow limits live in MConnection)
     recv_rate: int = 512000
 
@@ -95,6 +101,7 @@ class Config:
         cfg = cls(home=home, consensus=ConsensusConfig.test_config())
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.pex_ensure_interval_s = 0.5
         return cfg
 
 

@@ -152,6 +152,19 @@ class Node:
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.pex_reactor = None
+        if cfg.p2p.pex:
+            from tendermint_tpu.p2p.addrbook import AddrBook
+            from tendermint_tpu.p2p.pex import PEXReactor
+
+            self.addr_book = AddrBook(os.path.join(cfg.home, "addrbook.json"))
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                max_peers=cfg.p2p.max_num_peers,
+                node_key=self._node_key,
+                ensure_interval_s=cfg.p2p.pex_ensure_interval_s,
+            )
+            self.switch.add_reactor("pex", self.pex_reactor)
 
         self.listener: TcpListener | None = None
         self.rpc: RPCServer | None = None
@@ -183,11 +196,21 @@ class Node:
         return key
 
     def start(self) -> None:
-        self.switch.start()  # reactors start; consensus starts unless fast-syncing
         if self.config.p2p.laddr:
+            # bind BEFORE reactors start so the advertised listen_addr
+            # (NodeInfo/PEX) carries the real port
             self.listener = TcpListener(
                 self.switch, self.config.p2p.laddr, priv_key=self._node_key
             )
+            if self.config.p2p.external_address:
+                self.switch.listen_addr = self.config.p2p.external_address
+            else:
+                host = self.config.p2p.laddr.split("://", 1)[-1].rpartition(":")[0]
+                # single-host fallback only — multi-machine deployments
+                # must set p2p.external_address or peers learn loopback
+                adv_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
+                self.switch.listen_addr = f"{adv_host}:{self.listener.port}"
+        self.switch.start()  # reactors start; consensus starts unless fast-syncing
         if self.config.rpc.laddr:
             self.rpc = RPCServer(make_routes(self), self.config.rpc.laddr)
             self.rpc.start()

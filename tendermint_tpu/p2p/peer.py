@@ -17,13 +17,16 @@ from tendermint_tpu.p2p.transport import Endpoint
 
 @dataclass(frozen=True)
 class NodeInfo:
-    """Reference `p2p/peer.go` NodeInfo (identity + compat handshake)."""
+    """Reference `p2p/peer.go` NodeInfo (identity + compat handshake).
+    `listen_addr` is the peer's dialable address ("host:port", empty for
+    non-listening nodes) — PEX gossips it to other peers."""
 
     node_id: str  # hex of the node key address
     moniker: str
     chain_id: str
     version: str = "0.1.0"
     channels: tuple[int, ...] = ()
+    listen_addr: str = ""
 
     def encode(self) -> bytes:
         w = (
@@ -36,6 +39,7 @@ class NodeInfo:
         w.uvarint(len(self.channels))
         for c in self.channels:
             w.uvarint(c)
+        w.string(self.listen_addr)
         return w.build()
 
     @classmethod
@@ -48,7 +52,8 @@ class NodeInfo:
             r.string(),
         )
         channels = tuple(r.uvarint() for _ in range(r.uvarint()))
-        return cls(node_id, moniker, chain_id, version, channels)
+        listen_addr = r.string() if not r.done() else ""
+        return cls(node_id, moniker, chain_id, version, channels, listen_addr)
 
     def compatible_with(self, other: "NodeInfo") -> str | None:
         """None if compatible, else the reason (reference

@@ -1,0 +1,139 @@
+"""PEX reactor: peer-exchange discovery on channel 0x00.
+
+Reference `p2p/pex_reactor.go` — new peers land in the address book,
+address requests are answered with a random selection, and an
+ensure-peers loop dials book addresses until the switch reaches its
+target peer count.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.addrbook import AddrBook, NetAddress
+from tendermint_tpu.p2p.connection import ChannelDescriptor
+from tendermint_tpu.p2p.peer import Peer
+from tendermint_tpu.p2p.switch import Reactor
+
+PEX_CHANNEL = 0x00
+
+_MSG_REQUEST = 0x01
+_MSG_ADDRS = 0x02
+
+_MAX_ADDRS_PER_MSG = 32
+
+
+def encode_request() -> bytes:
+    return Writer().uvarint(_MSG_REQUEST).build()
+
+
+def encode_addrs(addrs: list[NetAddress]) -> bytes:
+    w = Writer().uvarint(_MSG_ADDRS).uvarint(len(addrs))
+    for a in addrs:
+        w.string(a.node_id).string(a.addr)
+    return w.build()
+
+
+def decode_message(payload: bytes):
+    r = Reader(payload)
+    tag = r.uvarint()
+    if tag == _MSG_REQUEST:
+        return ("request", None)
+    if tag == _MSG_ADDRS:
+        n = min(r.uvarint(), _MAX_ADDRS_PER_MSG)
+        return ("addrs", [NetAddress(r.string(), r.string()) for _ in range(n)])
+    raise ValueError(f"unknown pex message {tag:#x}")
+
+
+class PEXReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        dial_fn=None,
+        max_peers: int = 10,
+        node_key=None,
+        ensure_interval_s: float = 30.0,
+    ) -> None:
+        super().__init__()
+        self.book = book
+        self.max_peers = max_peers
+        self.node_key = node_key
+        self.ensure_interval_s = ensure_interval_s
+        self._dial_fn = dial_fn
+        self._running = False
+        self._requested: set[str] = set()
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(PEX_CHANNEL, priority=1)]
+
+    def on_start(self) -> None:
+        self._running = True
+        threading.Thread(
+            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self._running = False
+
+    def add_peer(self, peer: Peer) -> None:
+        # a connected peer's own listen address is book-worthy, but a
+        # SELF-CLAIMED inbound address stays in a NEW bucket until WE
+        # successfully dial it — promoting unproven addresses to OLD
+        # would let NAT'd/malicious peers poison the proven set
+        if peer.node_info.listen_addr:
+            self.book.add_address(
+                NetAddress(peer.id, peer.node_info.listen_addr), src_id=peer.id
+            )
+            if peer.outbound:
+                self.book.mark_good(peer.id)
+        # ask it for more addresses (once per peer)
+        if peer.id not in self._requested:
+            self._requested.add(peer.id)
+            peer.try_send(PEX_CHANNEL, encode_request())
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self._requested.discard(peer.id)
+
+    def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
+        kind, arg = decode_message(payload)
+        if kind == "request":
+            peer.try_send(
+                PEX_CHANNEL, encode_addrs(self.book.sample(_MAX_ADDRS_PER_MSG))
+            )
+        elif kind == "addrs":
+            me = self.switch.node_info.node_id if self.switch else ""
+            for addr in arg:
+                if addr.node_id != me:
+                    self.book.add_address(addr, src_id=peer.id)
+
+    # -- ensure-peers loop -------------------------------------------------
+
+    def _dial(self, addr: NetAddress) -> None:
+        if self._dial_fn is not None:
+            self._dial_fn(addr)
+            return
+        from tendermint_tpu.p2p.tcp import dial
+
+        dial(self.switch, addr.addr, priv_key=self.node_key)
+
+    def _ensure_peers_routine(self) -> None:
+        """Reference `ensurePeersRoutine`: top up outbound connections
+        from the book while below target."""
+        while self._running:
+            time.sleep(self.ensure_interval_s)
+            if self.switch is None:
+                continue
+            have = {p.id for p in self.switch.peers()}
+            if len(have) >= self.max_peers:
+                continue
+            addr = self.book.pick_address()
+            if addr is None or addr.node_id in have:
+                continue
+            self.book.mark_attempt(addr.node_id)
+            try:
+                self._dial(addr)
+                self.book.mark_good(addr.node_id)
+            except Exception:
+                pass  # attempts counter already bumped; book evicts flakes

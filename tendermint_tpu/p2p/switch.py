@@ -54,16 +54,18 @@ class Switch:
         self._peers: dict[str, Peer] = {}
         self._mtx = threading.RLock()
         self._running = False
+        self.listen_addr = node_info.listen_addr  # set once the listener binds
 
     @property
     def node_info(self) -> NodeInfo:
-        # advertise the registered channels
+        # advertise the registered channels + dialable address
         return NodeInfo(
             node_id=self._base_info.node_id,
             moniker=self._base_info.moniker,
             chain_id=self._base_info.chain_id,
             version=self._base_info.version,
             channels=tuple(d.id for d in self._descriptors),
+            listen_addr=self.listen_addr,
         )
 
     # -- reactors ----------------------------------------------------------

@@ -170,15 +170,26 @@ class TestCrashRecovery:
                 env=env,
             )
 
-        import random
+        import socket as socket_mod
 
-        port = random.randint(47100, 47900)
+        with socket_mod.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        def height_or_none():
+            # the freshly-(re)started subprocess may not serve RPC yet;
+            # transient connection errors are part of the wait
+            try:
+                return rpc(port, "status")["sync_info"]["latest_block_height"]
+            except Exception:
+                return None
+
         proc = run(port)
         try:
             assert proc.stdout.readline().strip() == b"UP"
             wait_until(
-                lambda: rpc(port, "status")["sync_info"]["latest_block_height"] >= 2,
-                timeout=60,
+                lambda: (height_or_none() or 0) >= 2,
+                timeout=90,
                 msg="first run commits",
             )
             h_before = rpc(port, "status")["sync_info"]["latest_block_height"]
@@ -190,9 +201,8 @@ class TestCrashRecovery:
         try:
             assert proc.stdout.readline().strip() == b"UP"
             wait_until(
-                lambda: rpc(port, "status")["sync_info"]["latest_block_height"]
-                >= h_before + 2,
-                timeout=60,
+                lambda: (height_or_none() or 0) >= h_before + 2,
+                timeout=90,
                 msg="chain resumes past pre-crash height",
             )
         finally:

@@ -1,0 +1,95 @@
+"""Address book + PEX discovery (reference `p2p/addrbook_test.go`,
+`p2p/pex_reactor_test.go`)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config
+from tendermint_tpu.node import Node
+from tendermint_tpu.p2p.addrbook import MAX_ATTEMPTS, AddrBook, NetAddress
+
+
+class TestAddrBook:
+    def test_add_promote_and_persist(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path)
+        a = NetAddress("aa" * 20, "127.0.0.1:1000")
+        b = NetAddress("bb" * 20, "127.0.0.1:2000")
+        assert book.add_address(a, src_id="seed")
+        assert book.add_address(b, src_id="seed")
+        assert not book.add_address(NetAddress("", ""), "x")
+        assert book.size() == 2
+        book.mark_good(a.node_id)
+        # old entries are not overwritten by later gossip
+        assert not book.add_address(
+            NetAddress(a.node_id, "9.9.9.9:1"), src_id="liar"
+        )
+        # restart survival
+        book2 = AddrBook(path)
+        assert book2.size() == 2
+        assert book2.has(a.node_id)
+        picked = {book2.pick_address().node_id for _ in range(50)}
+        assert picked <= {a.node_id, b.node_id}
+
+    def test_failed_attempts_evict_new_addresses(self, tmp_path):
+        book = AddrBook(str(tmp_path / "ab.json"))
+        a = NetAddress("cc" * 20, "127.0.0.1:3000")
+        book.add_address(a, "seed")
+        for _ in range(MAX_ATTEMPTS):
+            book.mark_attempt(a.node_id)
+        assert not book.has(a.node_id)  # flaky new address dropped
+        # but OLD (proven) addresses survive failed attempts
+        b = NetAddress("dd" * 20, "127.0.0.1:4000")
+        book.add_address(b, "seed")
+        book.mark_good(b.node_id)
+        for _ in range(MAX_ATTEMPTS + 2):
+            book.mark_attempt(b.node_id)
+        assert book.has(b.node_id)
+
+    def test_sample_bounded(self, tmp_path):
+        book = AddrBook(str(tmp_path / "ab.json"))
+        for i in range(40):
+            book.add_address(
+                NetAddress(f"{i:040x}", f"127.0.0.1:{5000+i}"), "seed"
+            )
+        assert len(book.sample(16)) == 16
+
+
+@pytest.mark.slow
+class TestPEXDiscovery:
+    def test_transitive_peer_discovery(self, tmp_path):
+        """A knows only B; C knows only B; PEX must connect A<->C."""
+        nodes = []
+        try:
+            for name in ("a", "b", "c"):
+                home = str(tmp_path / name)
+                cli_main(["init", "--home", home, "--chain-id", "pex-chain"])
+                cfg = Config.test_config(home)
+                cfg.base.fast_sync = False
+                cfg.base.moniker = name
+                nodes.append(Node(cfg))
+            # distinct validators not required: discovery is consensus-free,
+            # but all three share the chain id so handshakes pass
+            for n in nodes:
+                n.start()
+            a, b, c = nodes
+            from tendermint_tpu.p2p.tcp import dial
+
+            dial(a.switch, f"127.0.0.1:{b.p2p_port}", priv_key=a._node_key)
+            dial(c.switch, f"127.0.0.1:{b.p2p_port}", priv_key=c._node_key)
+
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if all(n.switch.n_peers() >= 2 for n in nodes):
+                    break
+                time.sleep(0.2)
+            assert all(
+                n.switch.n_peers() >= 2 for n in nodes
+            ), [n.switch.n_peers() for n in nodes]
+            # the books learned the transitive addresses
+            assert a.addr_book.has(c.node_id) or c.addr_book.has(a.node_id)
+        finally:
+            for n in nodes:
+                n.stop()
