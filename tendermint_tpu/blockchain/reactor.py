@@ -29,6 +29,8 @@ from tendermint_tpu.state.execution import apply_block
 from tendermint_tpu.types.block import Block
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.errors import ValidationError
+from tendermint_tpu.utils.log import kv, logger
+import logging
 
 BLOCKCHAIN_CHANNEL = 0x40
 
@@ -99,6 +101,7 @@ class BlockchainReactor(Reactor):
         self._running = False
         self._thread: threading.Thread | None = None
         self.blocks_synced = 0
+        self._progress_mark = time.monotonic()
 
     # -- reactor interface -------------------------------------------------
 
@@ -251,6 +254,22 @@ class BlockchainReactor(Reactor):
                     return
                 self.pool.pop()
                 self.blocks_synced += 1
+                self._log_progress()
+
+    def _log_progress(self) -> None:
+        """blocks/s every 100 blocks (reference `reactor.go:281-286`)."""
+        if self.blocks_synced % 100 != 0:
+            return
+        now = time.monotonic()
+        rate = 100.0 / max(now - self._progress_mark, 1e-9)
+        self._progress_mark = now
+        kv(
+            logger("blockchain"),
+            logging.INFO,
+            "fast-sync progress",
+            height=self.pool.height - 1,
+            blocks_per_s=round(rate, 1),
+        )
 
     def _sync_one(self, block, successor) -> None:
         if successor is None:

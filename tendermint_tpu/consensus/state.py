@@ -53,6 +53,8 @@ from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
 from tendermint_tpu.types.vote_set import VoteSet
 from tendermint_tpu.utils.fail import fail_point
+from tendermint_tpu.utils import log as _log_mod
+import logging as _logging
 
 _SENTINEL = object()
 
@@ -762,6 +764,14 @@ class ConsensusState:
         self.event_switch.fire(ev.EVENT_NEW_BLOCK, ev.EventDataNewBlock(block))
         self.event_switch.fire(
             ev.EVENT_NEW_BLOCK_HEADER, ev.EventDataNewBlockHeader(block.header)
+        )
+        _log_mod.kv(
+            _log_mod.logger("consensus"),
+            _logging.INFO,
+            "block committed",
+            height=height,
+            txs=len(block.data.txs),
+            hash=block.hash().hex()[:12],
         )
         # per-tx results: generic stream + hash-keyed (broadcast_tx_commit
         # waits on the keyed event — reference EventDataTx via event cache)

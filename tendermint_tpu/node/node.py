@@ -50,6 +50,9 @@ class Node:
     ) -> None:
         self.config = config
         cfg = config
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging(cfg.base.log_level)
 
         def _db(name: str) -> DB:
             if db_provider is not None:
@@ -149,6 +152,8 @@ class Node:
                 chain_id=self.genesis.chain_id,
             )
         )
+        self.switch.send_rate = cfg.p2p.send_rate
+        self.switch.recv_rate = cfg.p2p.recv_rate
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
@@ -198,9 +203,14 @@ class Node:
     def start(self) -> None:
         if self.config.p2p.laddr:
             # bind BEFORE reactors start so the advertised listen_addr
-            # (NodeInfo/PEX) carries the real port
+            # (NodeInfo/PEX) carries the real port — but don't ACCEPT
+            # until the reactors are running (an early inbound peer
+            # would hit pre-start reactors)
             self.listener = TcpListener(
-                self.switch, self.config.p2p.laddr, priv_key=self._node_key
+                self.switch,
+                self.config.p2p.laddr,
+                priv_key=self._node_key,
+                start=False,
             )
             if self.config.p2p.external_address:
                 self.switch.listen_addr = self.config.p2p.external_address
@@ -211,6 +221,8 @@ class Node:
                 adv_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
                 self.switch.listen_addr = f"{adv_host}:{self.listener.port}"
         self.switch.start()  # reactors start; consensus starts unless fast-syncing
+        if self.listener is not None:
+            self.listener.start_accepting()
         if self.config.rpc.laddr:
             self.rpc = RPCServer(make_routes(self), self.config.rpc.laddr)
             self.rpc.start()

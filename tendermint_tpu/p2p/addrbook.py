@@ -53,6 +53,9 @@ class _Entry:
     bucket: int = 0
 
 
+SAVE_INTERVAL_S = 5.0  # debounce: reference persists on a periodic routine
+
+
 class AddrBook:
     def __init__(self, file_path: str | None = None, key: bytes | None = None):
         self._path = file_path
@@ -60,6 +63,8 @@ class AddrBook:
         self._lock = threading.RLock()
         self._entries: dict[str, _Entry] = {}  # node_id -> entry
         self._rng = random.Random()
+        self._dirty = False
+        self._last_save = 0.0
         if file_path and os.path.exists(file_path):
             self._load()
 
@@ -181,8 +186,28 @@ class AddrBook:
     # -- persistence -------------------------------------------------------
 
     def _save(self) -> None:
-        if not self._path:
+        """Debounced: mutations mark dirty; the file is rewritten at most
+        once per SAVE_INTERVAL_S (reference `dumpAddressRoutine` persists
+        periodically, not per mutation — a 32-address PEX message would
+        otherwise serialize the whole book 32 times inside the peer's
+        recv thread). Call `flush()` for a synchronous write (shutdown).
+        """
+        self._dirty = True
+        if time.time() - self._last_save < SAVE_INTERVAL_S:
             return
+        self._flush_locked()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._dirty:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._path:
+            self._dirty = False
+            return
+        self._dirty = False
+        self._last_save = time.time()
         doc = {
             "key": self._key.hex(),
             "entries": [

@@ -19,6 +19,7 @@ from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
+from tendermint_tpu.utils.flowrate import Monitor
 
 
 @dataclass(frozen=True)
@@ -53,7 +54,13 @@ class MConnection:
         channels: list[ChannelDescriptor],
         on_receive,
         on_error=None,
+        send_limit: int = 0,
+        recv_limit: int = 0,
     ) -> None:
+        # per-connection throughput stats + optional rate caps
+        # (reference flowrate.Monitor at p2p/connection.go:72-73)
+        self.send_monitor = Monitor(send_limit)
+        self.recv_monitor = Monitor(recv_limit)
         self._endpoint = endpoint
         self._channels: dict[int, _Channel] = {
             d.id: _Channel(d) for d in channels
@@ -140,7 +147,9 @@ class MConnection:
                 frame = (
                     Writer().uvarint(ch.desc.id).bytes(payload).build()
                 )
+                self.send_monitor.throttle()
                 self._endpoint.send(frame)
+                self.send_monitor.update(len(frame))
                 ch.recently_sent += len(payload)
         except EndpointClosed:
             self._die(None)
@@ -153,6 +162,10 @@ class MConnection:
         try:
             while self._running:
                 frame = self._endpoint.recv()
+                self.recv_monitor.update(len(frame))
+                # inbound flow control: delay further reads once over
+                # the cap (the sender blocks on TCP backpressure)
+                self.recv_monitor.throttle()
                 r = Reader(frame)
                 chan_id = r.uvarint()
                 payload = r.bytes()

@@ -78,6 +78,8 @@ class Peer:
         on_receive,
         on_error,
         outbound: bool,
+        send_limit: int = 0,
+        recv_limit: int = 0,
     ) -> None:
         self.node_info = node_info
         self.outbound = outbound
@@ -87,7 +89,17 @@ class Peer:
             channels,
             lambda ch, payload: on_receive(ch, self, payload),
             lambda exc: on_error(self, exc),
+            send_limit=send_limit,
+            recv_limit=recv_limit,
         )
+
+    @property
+    def send_monitor(self):
+        return self._conn.send_monitor
+
+    @property
+    def recv_monitor(self):
+        return self._conn.recv_monitor
 
     @property
     def id(self) -> str:

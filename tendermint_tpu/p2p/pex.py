@@ -76,6 +76,7 @@ class PEXReactor(Reactor):
 
     def on_stop(self) -> None:
         self._running = False
+        self.book.flush()
 
     def add_peer(self, peer: Peer) -> None:
         # a connected peer's own listen address is book-worthy, but a
@@ -110,13 +111,12 @@ class PEXReactor(Reactor):
 
     # -- ensure-peers loop -------------------------------------------------
 
-    def _dial(self, addr: NetAddress) -> None:
+    def _dial(self, addr: NetAddress):
         if self._dial_fn is not None:
-            self._dial_fn(addr)
-            return
+            return self._dial_fn(addr)
         from tendermint_tpu.p2p.tcp import dial
 
-        dial(self.switch, addr.addr, priv_key=self.node_key)
+        return dial(self.switch, addr.addr, priv_key=self.node_key)
 
     def _ensure_peers_routine(self) -> None:
         """Reference `ensurePeersRoutine`: top up outbound connections
@@ -133,7 +133,15 @@ class PEXReactor(Reactor):
                 continue
             self.book.mark_attempt(addr.node_id)
             try:
-                self._dial(addr)
-                self.book.mark_good(addr.node_id)
+                peer = self._dial(addr)
             except Exception:
-                pass  # attempts counter already bumped; book evicts flakes
+                continue  # attempts counter already bumped; book evicts flakes
+            # promote ONLY if the authenticated identity matches the book
+            # entry — otherwise gossip pointed this node_id at someone
+            # else's address (eclipse attempt): purge it
+            if peer is not None and peer.id != addr.node_id:
+                self.book.remove(addr.node_id)
+                if self.switch is not None:
+                    self.switch.stop_peer_for_error(peer, "pex id mismatch")
+                continue
+            self.book.mark_good(addr.node_id)

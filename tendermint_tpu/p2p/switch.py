@@ -9,12 +9,14 @@ over in-memory pipes — the reference's net.Pipe test harness
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable
 
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import NodeInfo, Peer
 from tendermint_tpu.p2p.transport import Endpoint, pipe_pair
+from tendermint_tpu.utils.log import kv, logger
 
 
 class Reactor:
@@ -55,6 +57,9 @@ class Switch:
         self._mtx = threading.RLock()
         self._running = False
         self.listen_addr = node_info.listen_addr  # set once the listener binds
+        # per-peer flow caps, bytes/s (0 = unlimited; reference 500 kB/s)
+        self.send_rate = 0
+        self.recv_rate = 0
 
     @property
     def node_info(self) -> NodeInfo:
@@ -130,9 +135,19 @@ class Switch:
                 self._dispatch,
                 self._on_peer_error,
                 outbound,
+                send_limit=self.send_rate,
+                recv_limit=self.recv_rate,
             )
             self._peers[remote_info.node_id] = peer
         peer.start()
+        kv(
+            logger("p2p"),
+            logging.INFO,
+            "peer connected",
+            peer=remote_info.moniker,
+            id=remote_info.node_id[:12],
+            outbound=outbound,
+        )
         for r in self._reactors.values():
             r.add_peer(peer)
         return peer
@@ -143,6 +158,13 @@ class Switch:
                 return
             del self._peers[peer.id]
         peer.stop()
+        kv(
+            logger("p2p"),
+            logging.INFO,
+            "peer disconnected",
+            id=peer.id[:12],
+            reason=str(reason)[:60],
+        )
         for r in self._reactors.values():
             r.remove_peer(peer, reason)
 

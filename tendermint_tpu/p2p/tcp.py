@@ -100,12 +100,25 @@ class TcpListener:
     `p2p/secret.py`) before the NodeInfo exchange, and the peer's
     claimed node_id must match its authenticated identity key."""
 
-    def __init__(self, switch: Switch, laddr: str, priv_key=None) -> None:
+    def __init__(
+        self, switch: Switch, laddr: str, priv_key=None, start: bool = True
+    ) -> None:
         self.switch = switch
         self.priv_key = priv_key
         host, port = parse_laddr(laddr)
         self._srv = socket.create_server((host, port), reuse_port=False)
         self.addr = self._srv.getsockname()  # actual (host, port) after bind
+        self._running = False
+        self._thread: threading.Thread | None = None
+        if start:
+            self.start_accepting()
+
+    def start_accepting(self) -> None:
+        """Begin accepting connections. Separated from binding so a node
+        can learn its port (for the advertised listen_addr) BEFORE any
+        inbound peer can reach reactors that haven't started."""
+        if self._running:
+            return
         self._running = True
         self._thread = threading.Thread(
             target=self._accept_loop, name="p2p-accept", daemon=True

@@ -100,7 +100,15 @@ def make_routes(node) -> dict:
         return {
             "n_peers": len(peers),
             "peers": [
-                {"id": p.id, "moniker": p.node_info.moniker, "outbound": p.outbound}
+                {
+                    "id": p.id,
+                    "moniker": p.node_info.moniker,
+                    "outbound": p.outbound,
+                    "send_rate": round(p.send_monitor.rate, 1),
+                    "recv_rate": round(p.recv_monitor.rate, 1),
+                    "bytes_sent": p.send_monitor.total,
+                    "bytes_recv": p.recv_monitor.total,
+                }
                 for p in peers
             ],
         }

@@ -26,7 +26,8 @@ class TestAddrBook:
         assert not book.add_address(
             NetAddress(a.node_id, "9.9.9.9:1"), src_id="liar"
         )
-        # restart survival
+        # restart survival (saves are debounced; shutdown flushes)
+        book.flush()
         book2 = AddrBook(path)
         assert book2.size() == 2
         assert book2.has(a.node_id)
