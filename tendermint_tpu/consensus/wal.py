@@ -123,11 +123,46 @@ def _decode_record(data: bytes):
 
 
 class WAL:
-    def __init__(self, path: str, light: bool = False) -> None:
+    """Write-ahead log with size-based file rotation (reference
+    `autofile.Group` rolling files under `consensus/wal.go`). Segments
+    rotate only at ENDHEIGHT boundaries, so every file is a valid
+    record stream beginning at a height boundary; readers walk
+    `path.N` segments oldest-first, then the live `path` file."""
+
+    MAX_FILE_BYTES = 10 * 1024 * 1024  # reference autofile default
+    MAX_SEGMENTS = 10  # pruned oldest-first (reference Group head cap)
+
+    def __init__(
+        self,
+        path: str,
+        light: bool = False,
+        max_file_bytes: int | None = None,
+        max_segments: int | None = None,
+    ) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.light = light
+        self.max_file_bytes = max_file_bytes or self.MAX_FILE_BYTES
+        self.max_segments = max_segments or self.MAX_SEGMENTS
         self._f = open(path, "ab")
+
+    @staticmethod
+    def segment_paths(path: str) -> list[str]:
+        """All WAL files in replay order: rotated segments (ascending
+        index) then the live file."""
+        base = os.path.basename(path)
+        dirname = os.path.dirname(path) or "."
+        segs = []
+        if os.path.isdir(dirname):
+            for name in os.listdir(dirname):
+                if name.startswith(base + "."):
+                    suffix = name[len(base) + 1 :]
+                    if suffix.isdigit():
+                        segs.append((int(suffix), os.path.join(dirname, name)))
+        out = [p for _, p in sorted(segs)]
+        if os.path.exists(path):
+            out.append(path)
+        return out
 
     def save(self, item) -> None:
         """Frame + append + fsync (writes happen BEFORE processing)."""
@@ -139,6 +174,28 @@ class WAL:
         self._f.write(frame)
         self._f.flush()
         os.fsync(self._f.fileno())
+        # rotate only at height boundaries: every segment then starts
+        # with the records of a fresh height (replay never spans a cut)
+        if (
+            isinstance(item, EndHeightMessage)
+            and self._f.tell() >= self.max_file_bytes
+        ):
+            self._rotate()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        existing = self.segment_paths(self.path)
+        next_idx = 0
+        for seg in existing:
+            suffix = os.path.basename(seg)[len(os.path.basename(self.path)) + 1 :]
+            if suffix.isdigit():
+                next_idx = max(next_idx, int(suffix) + 1)
+        os.replace(self.path, f"{self.path}.{next_idx}")
+        # prune oldest segments beyond the cap
+        segs = [p for p in self.segment_paths(self.path) if p != self.path]
+        while len(segs) > self.max_segments:
+            os.remove(segs.pop(0))
+        self._f = open(self.path, "ab")
 
     def close(self) -> None:
         self._f.close()
@@ -147,10 +204,12 @@ class WAL:
 
     @staticmethod
     def iter_records(path: str) -> Iterator[object]:
-        """Decode records; stops cleanly at a truncated/corrupt tail
-        (a crash mid-write must not poison recovery)."""
-        for _, rec in WAL.iter_records_with_offsets(path):
-            yield rec
+        """Decode records across ALL segments in order; stops cleanly at
+        a truncated/corrupt tail (a crash mid-write must not poison
+        recovery)."""
+        for seg in WAL.segment_paths(path):
+            for _, rec in WAL.iter_records_with_offsets(seg):
+                yield rec
 
     @staticmethod
     def iter_records_with_offsets(path: str) -> Iterator[tuple[int, object]]:
@@ -178,7 +237,7 @@ class WAL:
         """Records after `#ENDHEIGHT <height-1>` — the inputs to replay
         for an in-progress `height`. None if no marker for height-1
         exists (reference `SearchForEndHeight :122`)."""
-        if not os.path.exists(path):
+        if not WAL.segment_paths(path):
             return None
         found = False
         out: list[object] = []

@@ -270,7 +270,73 @@ def make_routes(node) -> dict:
 
         return {"genesis": _json.loads(node.genesis.to_json())}
 
+    # -- unsafe profiling/introspection routes (reference
+    # `rpc/core/routes.go:36-45` + `dev.go`, served only with
+    # rpc.unsafe; the pprof-server analog for this runtime) ------------
+
+    _profiler: list = []
+
+    def unsafe_start_cpu_profiler() -> dict:
+        import cProfile
+
+        if _profiler:
+            raise RPCError(-32000, "profiler already running")
+        prof = cProfile.Profile()
+        prof.enable()
+        _profiler.append(prof)
+        return {"started": True}
+
+    def unsafe_stop_cpu_profiler(top: int = 25) -> dict:
+        import io
+        import pstats
+
+        if not _profiler:
+            raise RPCError(-32000, "profiler not running")
+        prof = _profiler.pop()
+        prof.disable()
+        buf = io.StringIO()
+        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(
+            int(top)
+        )
+        return {"profile": buf.getvalue()}
+
+    def unsafe_dump_threads() -> dict:
+        import sys
+        import threading
+        import traceback
+
+        frames = sys._current_frames()
+        out = {}
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            if frame is not None:
+                out[t.name] = traceback.format_stack(frame)[-3:]
+        return {"threads": out, "count": len(out)}
+
+    def unsafe_heap_summary(top: int = 20) -> dict:
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return {"started": True, "note": "call again for a snapshot"}
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[: int(top)]
+        return {
+            "top": [
+                {"where": str(s.traceback), "kb": round(s.size / 1024, 1)}
+                for s in stats
+            ]
+        }
+
+    routes_unsafe = {
+        "unsafe_start_cpu_profiler": unsafe_start_cpu_profiler,
+        "unsafe_stop_cpu_profiler": unsafe_stop_cpu_profiler,
+        "unsafe_dump_threads": unsafe_dump_threads,
+        "unsafe_heap_summary": unsafe_heap_summary,
+    }
+
     return {
+        **(routes_unsafe if node.config.rpc.unsafe else {}),
         "status": status,
         "net_info": net_info,
         "block": block,

@@ -78,3 +78,30 @@ class TestWALTools:
             rec.get("height", 0) < 2 or rec["type"] == "round_state"
             for rec in cut_lines
         ), cut_lines
+
+
+class TestUnsafeRoutes:
+    def test_profiling_and_introspection(self, tmp_path):
+        home = str(tmp_path / "unsafe")
+        cli_main(["init", "--home", home, "--chain-id", "unsafe-test"])
+        cfg = Config.test_config(home)
+        cfg.base.fast_sync = False
+        cfg.rpc.unsafe = True
+        node = Node(cfg)
+        node.start()
+        try:
+            c = LocalClient(node)
+            assert c._call("unsafe_start_cpu_profiler")["started"]
+            c.status()
+            prof = c._call("unsafe_stop_cpu_profiler")["profile"]
+            assert "cumulative" in prof
+            threads = c._call("unsafe_dump_threads")
+            assert threads["count"] > 3  # consensus/ticker/rpc threads live
+            assert any(v for v in threads["threads"].values())  # real stacks
+        finally:
+            node.stop()
+
+    def test_unsafe_routes_gated(self, solo_node):
+        c = LocalClient(solo_node)
+        with pytest.raises(RPCClientError, match="unknown method"):
+            c._call("unsafe_dump_threads")
