@@ -170,19 +170,30 @@ def _cmd_cut_wal_until(args) -> int:
     (reference `scripts/cutWALUntil/main.go` — builds crash fixtures)."""
     from tendermint_tpu.consensus.wal import WAL
 
-    with open(args.wal, "rb") as f:
-        data = f.read()
-    cut = len(data)
-    for off, rec in WAL.iter_records_with_offsets(args.wal):
-        rec_height = getattr(rec, "height", None)
-        if rec_height is None:
-            rec_height = getattr(getattr(rec, "msg", None), "height", None)
-        if rec_height is not None and rec_height >= args.height:
-            cut = off
-            break
+    # walk ALL segments in order (rotated files + live file) so the cut
+    # point is found wherever rotation put it; output is one flat file
+    out = bytearray()
+    total = 0
+    done = False
+    for seg in WAL.segment_paths(args.wal):
+        with open(seg, "rb") as f:
+            data = f.read()
+        total += len(data)
+        if done:
+            continue
+        cut = len(data)
+        for off, rec in WAL.iter_records_with_offsets(seg):
+            rec_height = getattr(rec, "height", None)
+            if rec_height is None:
+                rec_height = getattr(getattr(rec, "msg", None), "height", None)
+            if rec_height is not None and rec_height >= args.height:
+                cut = off
+                done = True
+                break
+        out += data[:cut]
     with open(args.output, "wb") as f:
-        f.write(data[:cut])
-    print(f"wrote {cut} of {len(data)} bytes to {args.output}")
+        f.write(bytes(out))
+    print(f"wrote {len(out)} of {total} bytes to {args.output}")
     return 0
 
 

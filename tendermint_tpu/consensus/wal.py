@@ -142,8 +142,12 @@ class WAL:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.light = light
-        self.max_file_bytes = max_file_bytes or self.MAX_FILE_BYTES
-        self.max_segments = max_segments or self.MAX_SEGMENTS
+        self.max_file_bytes = (
+            self.MAX_FILE_BYTES if max_file_bytes is None else max_file_bytes
+        )
+        self.max_segments = (
+            self.MAX_SEGMENTS if max_segments is None else max_segments
+        )
         self._f = open(path, "ab")
 
     @staticmethod
@@ -204,12 +208,45 @@ class WAL:
 
     @staticmethod
     def iter_records(path: str) -> Iterator[object]:
-        """Decode records across ALL segments in order; stops cleanly at
-        a truncated/corrupt tail (a crash mid-write must not poison
-        recovery)."""
-        for seg in WAL.segment_paths(path):
-            for _, rec in WAL.iter_records_with_offsets(seg):
+        """Decode records across ALL segments in order. A truncated or
+        corrupt TAIL of the live (last) file is tolerated — that is the
+        crash-mid-write case recovery exists for. Corruption inside a
+        ROTATED segment is data loss in the middle of the stream and
+        raises instead of silently yielding a gapped replay."""
+        segments = WAL.segment_paths(path)
+        for i, seg in enumerate(segments):
+            consumed = 0
+            for off, rec in WAL.iter_records_with_offsets(seg):
+                consumed = off
                 yield rec
+                # account the record we just yielded
+            # verify non-tail segments decoded to EOF
+            if i < len(segments) - 1:
+                size = os.path.getsize(seg)
+                # recompute clean end: walk frame headers cheaply
+                end = WAL._clean_end(seg)
+                if end != size:
+                    raise ValueError(
+                        f"corrupt WAL segment {seg}: decoded {end} of {size} bytes"
+                    )
+
+    @staticmethod
+    def _clean_end(path: str) -> int:
+        """Byte offset up to which `path` decodes cleanly."""
+        end = 0
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        while off + 8 <= len(data):
+            crc, length = struct.unpack_from(">II", data, off)
+            if off + 8 + length > len(data):
+                break
+            body = data[off + 8 : off + 8 + length]
+            if zlib.crc32(body) & 0xFFFFFFFF != crc:
+                break
+            off += 8 + length
+            end = off
+        return end
 
     @staticmethod
     def iter_records_with_offsets(path: str) -> Iterator[tuple[int, object]]:

@@ -274,31 +274,55 @@ def make_routes(node) -> dict:
     # `rpc/core/routes.go:36-45` + `dev.go`, served only with
     # rpc.unsafe; the pprof-server analog for this runtime) ------------
 
-    _profiler: list = []
+    # Sampling profiler across ALL threads: cProfile hooks only the
+    # calling thread, which over HTTP is a short-lived request-handler
+    # thread — it would capture nothing of the node's work. A sampler
+    # walking sys._current_frames() sees consensus/gossip/sync threads
+    # regardless of which thread starts it.
+    _profiler: dict = {}
 
-    def unsafe_start_cpu_profiler() -> dict:
-        import cProfile
+    def unsafe_start_cpu_profiler(interval_ms: int = 5) -> dict:
+        import collections
+        import sys
+        import threading
+        import time as time_mod
 
         if _profiler:
             raise RPCError(-32000, "profiler already running")
-        prof = cProfile.Profile()
-        prof.enable()
-        _profiler.append(prof)
-        return {"started": True}
+        counts = collections.Counter()
+        stop = threading.Event()
+
+        def sampler():
+            while not stop.is_set():
+                for frame in list(sys._current_frames().values()):
+                    counts[
+                        f"{frame.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                        f"{frame.f_lineno} {frame.f_code.co_name}"
+                    ] += 1
+                time_mod.sleep(max(int(interval_ms), 1) / 1000.0)
+
+        t = threading.Thread(target=sampler, name="rpc-profiler", daemon=True)
+        _profiler["stop"] = stop
+        _profiler["counts"] = counts
+        _profiler["thread"] = t
+        t.start()
+        return {"started": True, "interval_ms": int(interval_ms)}
 
     def unsafe_stop_cpu_profiler(top: int = 25) -> dict:
-        import io
-        import pstats
-
         if not _profiler:
             raise RPCError(-32000, "profiler not running")
-        prof = _profiler.pop()
-        prof.disable()
-        buf = io.StringIO()
-        pstats.Stats(prof, stream=buf).sort_stats("cumulative").print_stats(
-            int(top)
-        )
-        return {"profile": buf.getvalue()}
+        _profiler["stop"].set()
+        _profiler["thread"].join(timeout=2)
+        counts = _profiler["counts"]
+        _profiler.clear()
+        total = sum(counts.values()) or 1
+        return {
+            "samples": total,
+            "profile": [
+                {"where": where, "pct": round(100.0 * n / total, 1)}
+                for where, n in counts.most_common(int(top))
+            ],
+        }
 
     def unsafe_dump_threads() -> dict:
         import sys
@@ -313,19 +337,24 @@ def make_routes(node) -> dict:
                 out[t.name] = traceback.format_stack(frame)[-3:]
         return {"threads": out, "count": len(out)}
 
-    def unsafe_heap_summary(top: int = 20) -> dict:
+    def unsafe_heap_summary(top: int = 20, keep_tracing: bool = False) -> dict:
         import tracemalloc
 
         if not tracemalloc.is_tracing():
             tracemalloc.start()
             return {"started": True, "note": "call again for a snapshot"}
         snap = tracemalloc.take_snapshot()
+        # tracing taxes every allocation — turn it off once snapshotted
+        # unless the operator explicitly keeps it for a follow-up diff
+        if not keep_tracing:
+            tracemalloc.stop()
         stats = snap.statistics("lineno")[: int(top)]
         return {
+            "tracing": bool(keep_tracing),
             "top": [
                 {"where": str(s.traceback), "kb": round(s.size / 1024, 1)}
                 for s in stats
-            ]
+            ],
         }
 
     routes_unsafe = {

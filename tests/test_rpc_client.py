@@ -91,10 +91,13 @@ class TestUnsafeRoutes:
         node.start()
         try:
             c = LocalClient(node)
-            assert c._call("unsafe_start_cpu_profiler")["started"]
-            c.status()
-            prof = c._call("unsafe_stop_cpu_profiler")["profile"]
-            assert "cumulative" in prof
+            assert c._call("unsafe_start_cpu_profiler", interval_ms=2)["started"]
+            import time
+
+            time.sleep(0.3)  # sampler sees the live node threads
+            stopped = c._call("unsafe_stop_cpu_profiler")
+            assert stopped["samples"] > 10
+            assert stopped["profile"] and "where" in stopped["profile"][0]
             threads = c._call("unsafe_dump_threads")
             assert threads["count"] > 3  # consensus/ticker/rpc threads live
             assert any(v for v in threads["threads"].values())  # real stacks

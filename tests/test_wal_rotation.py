@@ -55,3 +55,40 @@ class TestWALRotation:
         # recent heights still replayable
         replay = WAL.records_since_last_end_height(path, height=11)
         assert replay is not None
+
+    def test_corrupt_rotated_segment_raises(self, tmp_path):
+        # corruption in a NON-tail segment is data loss mid-stream, not
+        # a crash tail: replay must fail loudly, not yield a gapped log
+        import pytest
+
+        path = str(tmp_path / "cs.wal")
+        wal = WAL(path, max_file_bytes=200, max_segments=10)
+        for h in range(1, 8):
+            wal.save(MsgRecord(_vote(h), "p"))
+            wal.save(EndHeightMessage(h))
+        wal.close()
+        segments = WAL.segment_paths(path)
+        assert len(segments) > 2
+        victim = segments[0]
+        data = bytearray(open(victim, "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        open(victim, "wb").write(bytes(data))
+        with pytest.raises(ValueError, match="corrupt WAL segment"):
+            list(WAL.iter_records(path))
+
+    def test_cut_wal_until_spans_segments(self, tmp_path, capsys):
+        from tendermint_tpu.cmd import main as cli_main
+
+        path = str(tmp_path / "cs.wal")
+        wal = WAL(path, max_file_bytes=200, max_segments=100)
+        for h in range(1, 10):
+            wal.save(MsgRecord(_vote(h), "p"))
+            wal.save(EndHeightMessage(h))
+        wal.close()
+        assert len(WAL.segment_paths(path)) > 2
+        out = str(tmp_path / "cut.wal")
+        assert cli_main(["cut_wal_until", path, "4", out]) == 0
+        heights = [
+            r.height for r in WAL.iter_records(out) if isinstance(r, EndHeightMessage)
+        ]
+        assert heights == [1, 2, 3]  # everything at/after height 4 cut
