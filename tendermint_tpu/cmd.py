@@ -170,12 +170,16 @@ def _cmd_cut_wal_until(args) -> int:
     (reference `scripts/cutWALUntil/main.go` — builds crash fixtures)."""
     from tendermint_tpu.consensus.wal import WAL
 
+    segments = WAL.segment_paths(args.wal)
+    if not segments:
+        print(f"error: no WAL found at {args.wal}", file=sys.stderr)
+        return 1
     # walk ALL segments in order (rotated files + live file) so the cut
     # point is found wherever rotation put it; output is one flat file
     out = bytearray()
     total = 0
     done = False
-    for seg in WAL.segment_paths(args.wal):
+    for seg in segments:
         with open(seg, "rb") as f:
             data = f.read()
         total += len(data)

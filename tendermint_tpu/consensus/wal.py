@@ -215,43 +215,30 @@ class WAL:
         raises instead of silently yielding a gapped replay."""
         segments = WAL.segment_paths(path)
         for i, seg in enumerate(segments):
-            consumed = 0
-            for off, rec in WAL.iter_records_with_offsets(seg):
-                consumed = off
+            end = 0
+            for off, rec, frame_len in WAL._iter_frames(seg):
+                end = off + frame_len
                 yield rec
-                # account the record we just yielded
-            # verify non-tail segments decoded to EOF
+            # non-tail segments must decode to EOF in the SAME pass
             if i < len(segments) - 1:
                 size = os.path.getsize(seg)
-                # recompute clean end: walk frame headers cheaply
-                end = WAL._clean_end(seg)
                 if end != size:
                     raise ValueError(
                         f"corrupt WAL segment {seg}: decoded {end} of {size} bytes"
                     )
 
     @staticmethod
-    def _clean_end(path: str) -> int:
-        """Byte offset up to which `path` decodes cleanly."""
-        end = 0
-        with open(path, "rb") as f:
-            data = f.read()
-        off = 0
-        while off + 8 <= len(data):
-            crc, length = struct.unpack_from(">II", data, off)
-            if off + 8 + length > len(data):
-                break
-            body = data[off + 8 : off + 8 + length]
-            if zlib.crc32(body) & 0xFFFFFFFF != crc:
-                break
-            off += 8 + length
-            end = off
-        return end
+    def iter_records_with_offsets(path: str) -> Iterator[tuple[int, object]]:
+        """(start_offset, record) pairs — WAL tooling truncates at these
+        offsets."""
+        for off, rec, _ in WAL._iter_frames(path):
+            yield off, rec
 
     @staticmethod
-    def iter_records_with_offsets(path: str) -> Iterator[tuple[int, object]]:
-        """(start_offset, record) pairs — the single place that knows the
-        on-disk frame layout (WAL tooling truncates at these offsets)."""
+    def _iter_frames(path: str) -> Iterator[tuple[int, object, int]]:
+        """(start_offset, record, frame_length) — the single place that
+        knows the on-disk frame layout; stops at a truncated/corrupt
+        tail."""
         with open(path, "rb") as f:
             data = f.read()
         off = 0
@@ -266,7 +253,7 @@ class WAL:
                 rec = _decode_record(body)
             except Exception:
                 return
-            yield off, rec
+            yield off, rec, 8 + length
             off += 8 + length
 
     @classmethod

@@ -224,7 +224,11 @@ class Node:
         if self.listener is not None:
             self.listener.start_accepting()
         if self.config.rpc.laddr:
-            self.rpc = RPCServer(make_routes(self), self.config.rpc.laddr)
+            self.rpc = RPCServer(
+                make_routes(self),
+                self.config.rpc.laddr,
+                event_switch=self.event_switch,
+            )
             self.rpc.start()
         for seed in filter(None, self.config.p2p.seeds.split(",")):
             try:

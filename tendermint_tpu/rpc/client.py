@@ -97,6 +97,108 @@ class HTTPClient(_ClientBase):
         return out["result"]
 
 
+class WSClient:
+    """WebSocket event-subscription client (reference
+    `rpc/lib/client/ws_client.go`). Blocking iterator interface:
+
+        ws = WSClient("127.0.0.1:46657")
+        ws.subscribe("NewBlock")
+        for event in ws.events(timeout=10): ...
+    """
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        import base64
+        import os
+        import socket
+
+        from tendermint_tpu.p2p.tcp import parse_laddr
+
+        host, port = parse_laddr(
+            address if "://" in address else f"tcp://{address}"
+        )
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        key = base64.b64encode(os.urandom(16)).decode()
+        self._sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                "Connection: Upgrade\r\nUpgrade: websocket\r\n"
+                f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        self._rfile = self._sock.makefile("rb")
+        status = self._rfile.readline()
+        if b"101" not in status:
+            raise RPCClientError(-32000, f"ws upgrade failed: {status!r}")
+        while self._rfile.readline() not in (b"\r\n", b""):
+            pass
+        self._id = 0
+        self._pending_events: list[dict] = []
+
+    def _send(self, method: str, **params) -> None:
+        from tendermint_tpu.rpc.websocket import encode_frame
+
+        self._id += 1
+        payload = json.dumps(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        ).encode()
+        self._sock.sendall(encode_frame(payload, mask=True))
+
+    def _recv_json(self, timeout: float | None = None) -> dict | None:
+        from tendermint_tpu.rpc.websocket import read_frame
+
+        self._sock.settimeout(timeout)
+        frame = read_frame(self._rfile)
+        if frame is None:
+            return None
+        opcode, payload = frame
+        if opcode != 0x1:
+            return self._recv_json(timeout)
+        return json.loads(payload)
+
+    def _recv_response(self, req_id: int, timeout: float) -> dict | None:
+        """Next message with our request id; event notifications that
+        arrive in the meantime are buffered for events()."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            msg = self._recv_json(max(deadline - _time.monotonic(), 0.05))
+            if msg is None:
+                return None
+            if msg.get("method") == "event":
+                self._pending_events.append(msg["params"])
+                continue
+            if msg.get("id") == req_id:
+                return msg
+        return None
+
+    def subscribe(self, event: str) -> None:
+        self._send("subscribe", event=event)
+        resp = self._recv_response(self._id, timeout=10)
+        if resp is None or "error" in resp:
+            raise RPCClientError(-32000, f"subscribe failed: {resp}")
+
+    def unsubscribe(self, event: str) -> None:
+        self._send("unsubscribe", event=event)
+
+    def events(self, timeout: float = 30.0):
+        """Yield event notification params until timeout/close."""
+        while self._pending_events:
+            yield self._pending_events.pop(0)
+        while True:
+            try:
+                msg = self._recv_json(timeout)
+            except (TimeoutError, OSError):
+                return
+            if msg is None:
+                return
+            if msg.get("method") == "event":
+                yield msg["params"]
+
+    def close(self) -> None:
+        self._sock.close()
+
+
 class LocalClient(_ClientBase):
     """In-process client over a Node's route table (reference
     `rpc/client/localclient.go` — no HTTP hop, same interface)."""

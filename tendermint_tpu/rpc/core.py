@@ -280,6 +280,7 @@ def make_routes(node) -> dict:
     # walking sys._current_frames() sees consensus/gossip/sync threads
     # regardless of which thread starts it.
     _profiler: dict = {}
+    _profiler_lock = __import__("threading").Lock()
 
     def unsafe_start_cpu_profiler(interval_ms: int = 5) -> dict:
         import collections
@@ -287,7 +288,12 @@ def make_routes(node) -> dict:
         import threading
         import time as time_mod
 
+        if not _profiler_lock.acquire(blocking=False):
+            raise RPCError(-32000, "profiler already running")
+        # held until unsafe_stop_cpu_profiler releases: two concurrent
+        # starts must not each spawn an (then-unstoppable) sampler
         if _profiler:
+            _profiler_lock.release()
             raise RPCError(-32000, "profiler already running")
         counts = collections.Counter()
         stop = threading.Event()
@@ -315,6 +321,7 @@ def make_routes(node) -> dict:
         _profiler["thread"].join(timeout=2)
         counts = _profiler["counts"]
         _profiler.clear()
+        _profiler_lock.release()
         total = sum(counts.values()) or 1
         return {
             "samples": total,
@@ -339,6 +346,9 @@ def make_routes(node) -> dict:
 
     def unsafe_heap_summary(top: int = 20, keep_tracing: bool = False) -> dict:
         import tracemalloc
+
+        if isinstance(keep_tracing, str):
+            keep_tracing = keep_tracing.strip().lower() in ("true", "1", "yes")
 
         if not tracemalloc.is_tracing():
             tracemalloc.start()

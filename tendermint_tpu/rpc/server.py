@@ -23,12 +23,14 @@ class RPCError(Exception):
 
 
 class RPCServer:
-    def __init__(self, routes: dict, laddr: str = "tcp://127.0.0.1:46657"):
+    def __init__(
+        self, routes: dict, laddr: str = "tcp://127.0.0.1:46657", event_switch=None
+    ):
         from tendermint_tpu.p2p.tcp import parse_laddr
 
         self.routes = routes
         host, port = parse_laddr(laddr)
-        handler = _make_handler(routes)
+        handler = _make_handler(routes, event_switch)
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.addr = self._httpd.server_address
         self._thread: threading.Thread | None = None
@@ -48,7 +50,7 @@ class RPCServer:
         self._httpd.server_close()
 
 
-def _make_handler(routes: dict):
+def _make_handler(routes: dict, event_switch=None):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -125,6 +127,13 @@ def _make_handler(routes: dict):
         def do_GET(self):
             url = urlparse(self.path)
             method = url.path.strip("/")
+            if (
+                method == "websocket"
+                and event_switch is not None
+                and "upgrade" in self.headers.get("Connection", "").lower()
+            ):
+                self._upgrade_websocket()
+                return
             if method == "":
                 # route listing (reference serves an index page)
                 self._respond({"jsonrpc": "2.0", "id": -1, "result": sorted(routes)})
@@ -139,5 +148,20 @@ def _make_handler(routes: dict):
                 else:
                     params[k] = v.strip('"')
             self._respond(self._call(-1, method, params))
+
+        def _upgrade_websocket(self):
+            from tendermint_tpu.rpc.websocket import WSSession, accept_key
+
+            key = self.headers.get("Sec-WebSocket-Key", "")
+            if not key:
+                self.send_error(400, "missing Sec-WebSocket-Key")
+                return
+            self.send_response(101, "Switching Protocols")
+            self.send_header("Upgrade", "websocket")
+            self.send_header("Connection", "Upgrade")
+            self.send_header("Sec-WebSocket-Accept", accept_key(key))
+            self.end_headers()
+            self.close_connection = True
+            WSSession(self, event_switch).run()
 
     return Handler

@@ -108,3 +108,52 @@ class TestUnsafeRoutes:
         c = LocalClient(solo_node)
         with pytest.raises(RPCClientError, match="unknown method"):
             c._call("unsafe_dump_threads")
+
+
+class TestWebSocketSubscribe:
+    def test_subscribe_receives_new_block_events(self, solo_node):
+        from tendermint_tpu.rpc.client import WSClient
+
+        ws = WSClient(f"127.0.0.1:{solo_node.rpc_port}")
+        try:
+            ws.subscribe("NewBlock")
+            got = []
+            for ev in ws.events(timeout=30):
+                got.append(ev)
+                if len(got) >= 2:
+                    break
+            assert len(got) >= 2
+            assert got[0]["event"] == "NewBlock"
+            assert got[1]["height"] > got[0]["height"]
+            assert len(got[0]["hash"]) == 64
+        finally:
+            ws.close()
+
+    def test_tx_event_subscription(self, solo_node):
+        import threading
+
+        from tendermint_tpu.rpc.client import HTTPClient, WSClient
+        from tendermint_tpu.types.tx import tx_hash
+
+        raw = b"ws-key=ws-val"
+        ws = WSClient(f"127.0.0.1:{solo_node.rpc_port}")
+        try:
+            ws.subscribe(f"Tx:{tx_hash(raw).hex()}")
+            c = HTTPClient(f"127.0.0.1:{solo_node.rpc_port}")
+            threading.Thread(
+                target=lambda: c.broadcast_tx_commit(raw), daemon=True
+            ).start()
+            events = list(_take(ws.events(timeout=30), 1))
+            assert events and events[0]["code"] == 0
+            assert bytes.fromhex(events[0]["tx"]) == raw
+        finally:
+            ws.close()
+
+
+def _take(gen, n):
+    out = []
+    for item in gen:
+        out.append(item)
+        if len(out) >= n:
+            break
+    return out
