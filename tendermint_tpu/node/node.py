@@ -173,6 +173,7 @@ class Node:
 
         self.listener: TcpListener | None = None
         self.rpc: RPCServer | None = None
+        self.grpc = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -230,6 +231,11 @@ class Node:
                 event_switch=self.event_switch,
             )
             self.rpc.start()
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.rpc.grpc_api import GRPCBroadcastServer
+
+            self.grpc = GRPCBroadcastServer(self, self.config.rpc.grpc_laddr)
+            self.grpc.start()
         for seed in filter(None, self.config.p2p.seeds.split(",")):
             try:
                 dial(self.switch, seed.strip(), priv_key=self._node_key)
@@ -239,6 +245,8 @@ class Node:
                 logging.getLogger(__name__).warning("dial %s failed", seed)
 
     def stop(self) -> None:
+        if self.grpc is not None:
+            self.grpc.stop()
         if self.rpc is not None:
             self.rpc.stop()
         if self.listener is not None:

@@ -169,6 +169,23 @@ def make_routes(node) -> dict:
         if node.consensus is None:
             raise RPCError(-32000, "consensus not running")
         rs = node.consensus.get_round_state()
+        peers = []
+        reactor = getattr(node, "consensus_reactor", None)
+        if reactor is not None and node.switch is not None:
+            for p in node.switch.peers():
+                ps = p.get(reactor.PEER_STATE_KEY)
+                if ps is None:
+                    continue
+                prs = ps.snapshot()
+                peers.append(
+                    {
+                        "id": p.id,
+                        "height": prs.height,
+                        "round": prs.round,
+                        "step": prs.step,
+                        "has_proposal": prs.proposal,
+                    }
+                )
         return {
             "height": rs.height,
             "round": rs.round,
@@ -176,6 +193,7 @@ def make_routes(node) -> dict:
             "proposal": rs.proposal is not None,
             "locked_round": rs.locked_round,
             "validators": len(rs.validators),
+            "peers": peers,
         }
 
     def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:

@@ -157,3 +157,27 @@ def _take(gen, n):
         if len(out) >= n:
             break
     return out
+
+
+class TestGRPCBroadcast:
+    def test_ping_and_broadcast_tx(self, tmp_path):
+        from tendermint_tpu.rpc.grpc_api import GRPCBroadcastClient
+
+        home = str(tmp_path / "grpc")
+        cli_main(["init", "--home", home, "--chain-id", "grpc-test"])
+        cfg = Config.test_config(home)
+        cfg.base.fast_sync = False
+        cfg.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+        node = Node(cfg)
+        node.start()
+        try:
+            c = GRPCBroadcastClient(f"127.0.0.1:{node.grpc.port}")
+            assert c.ping()
+            res = c.broadcast_tx(b"grpc-key=grpc-val")
+            assert res["deliver_tx"]["code"] == 0
+            assert res["height"] >= 1
+            q = LocalClient(node).abci_query(data=b"grpc-key")
+            assert bytes.fromhex(q["value"]) == b"grpc-val"
+            c.close()
+        finally:
+            node.stop()
