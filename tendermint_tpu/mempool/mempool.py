@@ -210,6 +210,22 @@ class Mempool:
         if self._wal is not None:
             self._wal.close()
 
+    def replay_wal(self) -> int:
+        """Restart recovery: re-validate WAL txs through the app and
+        COMPACT the file to the survivors (check_tx re-appends them;
+        committed/now-invalid txs are dropped by the recheck and the
+        dup-cache). Returns the number of txs that rejoined the pool."""
+        if self._wal is None:
+            return 0
+        txs = self.load_wal()
+        path = self._wal.name
+        self._wal.close()
+        self._wal = open(path, "wb")  # truncate; survivors re-append
+        before = self.size()
+        for tx in txs:
+            self.check_tx(tx)
+        return self.size() - before
+
     def load_wal(self) -> list[bytes]:
         """Replay the mempool WAL (txs seen before a crash); stops at a
         truncated tail."""

@@ -105,6 +105,9 @@ class Node:
             wal_dir=cfg.mempool_wal_path() if cfg.mempool.wal_dir else None,
             recheck=cfg.mempool.recheck,
         )
+        # re-validate txs that were in flight before a crash; the WAL is
+        # compacted to the survivors so it cannot grow across restarts
+        self.mempool.replay_wal()
         self.tx_indexer = KVTxIndexer(_db("txindex"))
         self.event_switch = ev.EventSwitch()
 

@@ -195,3 +195,20 @@ class TestBlockStore:
         store2 = BlockStore(db)
         assert store2.height == 1
         assert store2.load_block(1).hash() == block.hash()
+
+
+def test_replay_wal_recovers_and_compacts(tmp_path):
+    mp, _ = _mempool(wal_dir=str(tmp_path))
+    mp.check_tx(b"a=1")
+    mp.check_tx(b"b=2")
+    mp.close()
+    # restart: fresh mempool over the same WAL dir
+    mp2, _ = _mempool(wal_dir=str(tmp_path))
+    n = mp2.replay_wal()
+    assert n == 2
+    assert {bytes(t) for t in mp2.reap(-1)} == {b"a=1", b"b=2"}
+    # compaction: a second restart replays the same two, not four
+    mp2.close()
+    mp3, _ = _mempool(wal_dir=str(tmp_path))
+    assert mp3.load_wal() == [b"a=1", b"b=2"]
+    mp3.close()
