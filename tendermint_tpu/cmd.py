@@ -112,6 +112,22 @@ def _cmd_testnet(args) -> int:
     return 0
 
 
+def _cmd_probe_upnp(args) -> int:
+    """Discover a UPnP gateway and test a port mapping (reference
+    `commands/probe_upnp.go`)."""
+    import json as _json
+
+    from tendermint_tpu.p2p import upnp
+
+    try:
+        result = upnp.probe(port=args.port)
+    except upnp.UPnPError as e:
+        print(f"probe failed: {e}", file=sys.stderr)
+        return 1
+    print(_json.dumps(result))
+    return 0
+
+
 def _cmd_version(args) -> int:
     from tendermint_tpu.version import __version__
 
@@ -232,6 +248,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("show_validator", help="print the validator pubkey")
     p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
     p.set_defaults(fn=_cmd_show_validator)
+
+    p = sub.add_parser("probe_upnp", help="test UPnP gateway port mapping")
+    p.add_argument("--port", type=int, default=46656)
+    p.set_defaults(fn=_cmd_probe_upnp)
 
     p = sub.add_parser("version", help="print the version")
     p.set_defaults(fn=_cmd_version)

@@ -211,19 +211,36 @@ class Mempool:
             self._wal.close()
 
     def replay_wal(self) -> int:
-        """Restart recovery: re-validate WAL txs through the app and
-        COMPACT the file to the survivors (check_tx re-appends them;
-        committed/now-invalid txs are dropped by the recheck and the
-        dup-cache). Returns the number of txs that rejoined the pool."""
+        """Restart recovery: re-validate WAL txs through the app, then
+        atomically COMPACT the file to the txs that actually rejoined
+        the pool. The old WAL stays intact on disk until the rewrite
+        (a crash mid-replay loses nothing), and app-rejected txs are
+        excluded (check_tx's own WAL append is suppressed during
+        replay). Returns the number of txs that rejoined."""
         if self._wal is None:
             return 0
+        from tendermint_tpu.codec.binary import encode_bytes
+
         txs = self.load_wal()
         path = self._wal.name
-        self._wal.close()
-        self._wal = open(path, "wb")  # truncate; survivors re-append
+        wal, self._wal = self._wal, None  # suppress appends during replay
         before = self.size()
-        for tx in txs:
-            self.check_tx(tx)
+        try:
+            for tx in txs:
+                self.check_tx(tx)
+        finally:
+            self._wal = wal
+        # atomic rewrite with only the survivors
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            with self._lock:
+                for m in self._txs:
+                    f.write(encode_bytes(m.tx))
+            f.flush()
+            os.fsync(f.fileno())
+        self._wal.close()
+        os.replace(tmp, path)
+        self._wal = open(path, "ab")
         return self.size() - before
 
     def load_wal(self) -> list[bytes]:

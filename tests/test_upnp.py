@@ -1,0 +1,136 @@
+"""UPnP IGD discovery + port mapping against a FAKE gateway
+(reference `p2p/upnp` — real gateways don't exist in CI, so the SSDP
+responder and SOAP endpoint are local stand-ins)."""
+
+import re
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+from tendermint_tpu.p2p import upnp
+
+_DESC = """<?xml version="1.0"?>
+<root>
+  <device>
+    <serviceList>
+      <service>
+        <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+        <controlURL>/ctl</controlURL>
+      </service>
+    </serviceList>
+  </device>
+</root>"""
+
+
+class FakeGateway:
+    """UDP SSDP responder + HTTP description/SOAP endpoint."""
+
+    def __init__(self):
+        self.mappings = {}
+        self.requests = []
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _respond(self, body: str):
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._respond(_DESC)
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers.get("Content-Length", 0))
+                ).decode()
+                action = self.headers.get("SOAPAction", "")
+                fake.requests.append(action)
+                if "GetExternalIPAddress" in action:
+                    self._respond(
+                        "<NewExternalIPAddress>203.0.113.7</NewExternalIPAddress>"
+                    )
+                elif "AddPortMapping" in action:
+                    port = re.search(
+                        r"<NewExternalPort>(\d+)</NewExternalPort>", body
+                    ).group(1)
+                    client = re.search(
+                        r"<NewInternalClient>([^<]*)</NewInternalClient>", body
+                    ).group(1)
+                    fake.mappings[int(port)] = client
+                    self._respond("<ok/>")
+                elif "DeletePortMapping" in action:
+                    port = re.search(
+                        r"<NewExternalPort>(\d+)</NewExternalPort>", body
+                    ).group(1)
+                    fake.mappings.pop(int(port), None)
+                    self._respond("<ok/>")
+                else:
+                    self.send_error(500)
+
+        self.httpd = HTTPServer(("127.0.0.1", 0), Handler)
+        self.http_port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+        # SSDP over localhost UDP (unicast stand-in for the multicast)
+        self.udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.udp.bind(("127.0.0.1", 0))
+        self.ssdp_addr = self.udp.getsockname()
+
+        def ssdp_loop():
+            while True:
+                try:
+                    data, src = self.udp.recvfrom(2048)
+                except OSError:
+                    return
+                if b"M-SEARCH" in data:
+                    resp = (
+                        "HTTP/1.1 200 OK\r\n"
+                        f"LOCATION: http://127.0.0.1:{self.http_port}/desc.xml\r\n"
+                        "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n\r\n"
+                    )
+                    self.udp.sendto(resp.encode(), src)
+
+        threading.Thread(target=ssdp_loop, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.udp.close()
+
+
+class TestUPnP:
+    def test_probe_maps_and_cleans_up(self):
+        gw = FakeGateway()
+        try:
+            result = upnp.probe(port=46700, ssdp_addr=gw.ssdp_addr)
+            assert result["external_ip"] == "203.0.113.7"
+            assert result["port"] == 46700
+            # mapping was created then deleted (probe cleans up)
+            assert 46700 not in gw.mappings
+            actions = " ".join(gw.requests)
+            assert "AddPortMapping" in actions and "DeletePortMapping" in actions
+        finally:
+            gw.stop()
+
+    def test_add_and_delete_mapping(self):
+        gw = FakeGateway()
+        try:
+            g = upnp.discover(ssdp_addr=gw.ssdp_addr)
+            assert g.service_type.endswith("WANIPConnection:1")
+            upnp.add_port_mapping(g, 46701, 46656)
+            assert gw.mappings.get(46701) == g.local_ip
+            upnp.delete_port_mapping(g, 46701)
+            assert 46701 not in gw.mappings
+        finally:
+            gw.stop()
+
+    def test_no_gateway_raises(self):
+        import pytest
+
+        with pytest.raises(upnp.UPnPError, match="no UPnP gateway"):
+            upnp.discover(timeout=0.3, ssdp_addr=("127.0.0.1", 9))
