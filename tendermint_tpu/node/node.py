@@ -9,7 +9,6 @@ genesis -> state -> ABCI conns + handshake -> mempool -> reactors
 from __future__ import annotations
 
 import os
-import threading
 
 from tendermint_tpu.abci.client import local_client_creator
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
@@ -19,7 +18,7 @@ from tendermint_tpu.consensus.reactor import ConsensusReactor
 from tendermint_tpu.consensus.replay import Handshaker
 from tendermint_tpu.consensus.state import ConsensusState
 from tendermint_tpu.consensus.ticker import TimeoutTicker
-from tendermint_tpu.db.kv import DB, MemDB, SQLiteDB
+from tendermint_tpu.db.kv import DB, SQLiteDB
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
 from tendermint_tpu.p2p.peer import NodeInfo

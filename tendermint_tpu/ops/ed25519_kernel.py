@@ -33,7 +33,6 @@ and non-canonical encodings.
 from __future__ import annotations
 
 import hashlib
-from functools import partial
 
 import jax
 import jax.numpy as jnp
