@@ -111,6 +111,81 @@ def _precomp_limbs(x: int, y: int) -> np.ndarray:
     )
 
 
+def _host_decompress(pub: bytes) -> tuple[int, int] | None:
+    """RFC 8032 point decoding over Python ints (host build path)."""
+    from tendermint_tpu.ops.ed25519_kernel import SQRT_M1
+
+    enc = int.from_bytes(pub, "little")
+    sign = enc >> 255
+    y = enc & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    if v * x * x % P != u:
+        if v * x * x % P == (P - u) % P:
+            x = x * SQRT_M1 % P
+        else:
+            return None
+    if x == 0 and sign:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return x, y
+
+
+def host_build_key_tables(pubkeys) -> tuple[np.ndarray, np.ndarray]:
+    """Python-int table build: same layout as build_key_tables
+    ((1024, N, 60) int32 window-major tables of -A multiples, (N,) ok)
+    without compiling the device build kernel. Intended for small N
+    (tests, the multichip dryrun); one Montgomery batched inversion per
+    key normalizes all 960 entries.
+
+    Invalid pubkey encodings get identity-entry columns and ok=False.
+    An identity column degrades the check to encode([S]B) == R, which an
+    attacker CAN satisfy — callers must AND key_ok into every verdict
+    (the service layer and sharded step's lane_ok input both do)."""
+    n = len(pubkeys)
+    ok = np.zeros(n, dtype=bool)
+    tbl = np.zeros((A_NWIN * 16, n, 3 * NLIMBS), dtype=np.int32)
+    ident_entry = _precomp_limbs(0, 1).reshape(-1)
+    for col, pk in enumerate(pubkeys):
+        aff = _host_decompress(bytes(pk)) if len(pk) == 32 else None
+        if aff is None:
+            tbl[:, col] = ident_entry
+            continue
+        ok[col] = True
+        x, y = aff
+        nx = (P - x) % P  # tables hold multiples of -A
+        base = (nx, y, 1, nx * y % P)
+        rows: list[int] = []  # (row_index) parallel to entries
+        entries: list[tuple[int, int, int, int]] = []
+        for w in range(A_NWIN):
+            e = _H_IDENT
+            for d in range(16):
+                if d == 0:
+                    tbl[w * 16, col] = ident_entry
+                else:
+                    rows.append(w * 16 + d)
+                    entries.append(e)
+                e = _hadd(e, base)
+            for _ in range(A_WINDOW):
+                base = _hadd(base, base)
+        # batched affine normalization (Montgomery trick): 1 modexp/key
+        prefix = [1]
+        for pt in entries:
+            prefix.append(prefix[-1] * pt[2] % P)
+        inv = pow(prefix[-1], P - 2, P)
+        for i in reversed(range(len(entries))):
+            zi = inv * prefix[i] % P
+            inv = inv * entries[i][2] % P
+            ex, ey = entries[i][0] * zi % P, entries[i][1] * zi % P
+            tbl[rows[i], col] = _precomp_limbs(ex, ey).reshape(-1)
+    return tbl, ok
+
+
 _B_TABLE: np.ndarray | None = None
 
 

@@ -22,6 +22,7 @@ except AttributeError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
 from tendermint_tpu.ops.ed25519_kernel import verify_kernel
+from tendermint_tpu.ops.ed25519_tables import verify_tables_kernel
 
 BATCH_AXIS = "batch"
 
@@ -80,6 +81,69 @@ def sharded_verify_and_tally(mesh: Mesh):
         return ok, total
 
     return _step
+
+
+def sharded_tables_verify_and_tally(mesh: Mesh):
+    """Compile the TABLE fast path — the production steady-state kernel
+    (`ops.ed25519_tables`) — over the mesh.
+
+    Sharding is along the VALIDATOR axis: each device holds 1/ndev of the
+    comb-table columns (tables (1024, N, 60) sharded on axis 1 — 2.5 GB at
+    N=10k splits to ~300 MB/chip) plus the lanes of its own validators for
+    all K stacked commits. Lane arrays must be in shard-major order (see
+    shard_lanes_validator_major); the >2/3 power tally is psum-reduced so
+    every shard holds the global total.
+
+    Inputs: tables (1024, N, 60) int32; s/h/r (K*N, 32) uint8; lane_ok
+    (K*N,) bool — the host precheck AND the table build's key_ok tiled
+    over commits (an invalid-key table column degrades to a forgeable
+    check, so it MUST be masked in-device before the tally); powers
+    (K*N,) int32. ALL lane arrays — s, h, r, lane_ok, powers — must be
+    in the same shard-major order (shard_lanes_validator_major).
+    Returns ((K*N,) bool shard-major verdicts, () int32 global tally).
+    """
+    lane_spec = P(BATCH_AXIS)
+    tbl_spec = P(None, BATCH_AXIS, None)
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(tbl_spec,) + (lane_spec,) * 5,
+        out_specs=(lane_spec, P()),
+    )
+    def _step(tables, s, h, r, lane_ok, power):
+        ok = verify_tables_kernel(tables, s, h, r) & lane_ok
+        local = jnp.sum(jnp.where(ok, power, 0).astype(jnp.int32))
+        total = jax.lax.psum(local, BATCH_AXIS)
+        return ok, total
+
+    return _step
+
+
+def shard_lanes_validator_major(arrays, n_vals: int, n_shards: int):
+    """Reorder commit-major lanes (lane = c*N + v, the
+    prepare_commit_lanes layout) into shard-major order (shard, commit,
+    local validator) so a P(batch) sharding of the lane axis hands every
+    device exactly the lanes of its own table columns. N must divide
+    evenly into n_shards blocks."""
+    if n_vals % n_shards:
+        raise ValueError(f"n_vals {n_vals} not divisible by {n_shards} shards")
+    out = []
+    for a in arrays:
+        k = a.shape[0] // n_vals
+        a2 = a.reshape((k, n_shards, n_vals // n_shards) + a.shape[1:])
+        out.append(
+            np.ascontiguousarray(np.moveaxis(a2, 1, 0)).reshape(a.shape)
+        )
+    return out
+
+
+def unshard_lanes_validator_major(a, n_vals: int, n_shards: int):
+    """Inverse of shard_lanes_validator_major (device order -> commit-major)."""
+    k = a.shape[0] // n_vals
+    a2 = a.reshape((n_shards, k, n_vals // n_shards) + a.shape[1:])
+    return np.ascontiguousarray(np.moveaxis(a2, 0, 1)).reshape(a.shape)
 
 
 def pad_to_multiple(arrays, powers, multiple: int):
