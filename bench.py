@@ -34,18 +34,21 @@ def _bench_sigs(n_sigs: int):
     return pubs, msgs, sigs
 
 
-def _bench_verify_tables(n_vals: int, stack: int = 16, warm_reps: int = 4) -> dict:
+def _bench_verify_tables(n_vals: int, stack: int = 64, warm_reps: int = 4) -> dict:
     """Steady-state consensus path: cached valset comb tables
     (ops.ed25519_tables, the TableBatchVerifier backend).
 
     Measures two shapes:
-    * one commit (B = n_vals lanes) — the consensus-loop latency number;
+    * one commit (B = n_vals lanes) — the consensus-loop latency number
+      (runs the materialized-entries pallas chain; K=1 doesn't tile the
+      fused kernel);
     * `stack` commits of the same valset stacked into one device batch
       (B = stack*n_vals) — the fast-sync throughput number (BASELINE
-      config 3 shape). Stacking matters because every executable launch
-      through the axon tunnel costs ~86 ms wall-clock regardless of
-      size (measured: a bare 4096x4096 matmul and a 4-byte d2h sync
-      both pay it), so per-execution work must be large.
+      config 3 shape), which takes the FUSED select+accumulate pallas
+      kernel (in-kernel table selection, table read once per launch).
+      Stacking matters because launches neither pipeline nor come free
+      (~60 ms fixed dispatch overhead measured through the axon
+      tunnel), so per-execution work must be large.
     """
     import jax
 
@@ -174,7 +177,7 @@ def main() -> None:
     import jax
 
     sys.stderr.write(f"devices: {jax.devices()}\n")
-    t10k = _bench_verify_tables(10_240)
+    t10k = _bench_verify_tables(10_240, stack=64)
     sys.stderr.write(f"tables@10k: {t10k}\n")
     # fast-sync shape at 1k validators (BASELINE config 3): a window of
     # commits batched per device call -> blocks verified per second

@@ -28,6 +28,18 @@ identical per-signature verdict semantics (bad signatures localize).
 
 Tables hold multiples of -A so the device accumulates
 [S]B + [h](-A) and checks its encoding equals sig[:32].
+
+Two device pipelines share these tables:
+
+* the FUSED pallas kernel (production, TPU): selection happens inside
+  the kernel from int16 table blocks, the accumulator lives in VMEM,
+  and the table streams from HBM exactly once per launch — see the
+  "fused select+accumulate" section below and
+  docs/PLATFORM_NOTES.md for measured rates (1.44M verifies/s at
+  K=64 x 10,240 on the bench chip);
+* the materialized-entries path (XLA scan or the earlier pallas madd
+  chain): portable, used for shapes that don't tile the fused kernel
+  (single commits, tiny valsets) and by the CPU test mesh.
 """
 
 from __future__ import annotations
@@ -61,7 +73,8 @@ from tendermint_tpu.ops.ed25519_kernel import (
 
 A_WINDOW = 4  # per-validator tables: 64 windows x 16 entries
 A_NWIN = 64
-B_NWIN = 32  # fixed-base table: 32 windows x 256 entries (w=8)
+B_NWIN = 32  # fixed-base table: 32 windows x 256 entries (w=8, XLA path)
+SB_NWIN = 64  # fixed-base table: 64 windows x 16 entries (w=4, fused path)
 
 
 # -- host EC over Python ints (B-table build + tests) -------------------------
@@ -138,10 +151,10 @@ def _host_decompress(pub: bytes) -> tuple[int, int] | None:
 
 def host_build_key_tables(pubkeys) -> tuple[np.ndarray, np.ndarray]:
     """Python-int table build: same layout as build_key_tables
-    ((1024, N, 60) int32 window-major tables of -A multiples, (N,) ok)
-    without compiling the device build kernel. Intended for small N
-    (tests, the multichip dryrun); one Montgomery batched inversion per
-    key normalizes all 960 entries.
+    ((64, 16, 60, N) int16 window/digit/limb/validator tables of -A
+    multiples, (N,) ok) without compiling the device build kernel.
+    Intended for small N (tests, the multichip dryrun); one Montgomery
+    batched inversion per key normalizes all 960 entries.
 
     Invalid pubkey encodings get identity-entry columns and ok=False.
     An identity column degrades the check to encode([S]B) == R, which an
@@ -149,26 +162,26 @@ def host_build_key_tables(pubkeys) -> tuple[np.ndarray, np.ndarray]:
     (the service layer and sharded step's lane_ok input both do)."""
     n = len(pubkeys)
     ok = np.zeros(n, dtype=bool)
-    tbl = np.zeros((A_NWIN * 16, n, 3 * NLIMBS), dtype=np.int32)
+    tbl = np.zeros((A_NWIN, 16, 3 * NLIMBS, n), dtype=np.int16)
     ident_entry = _precomp_limbs(0, 1).reshape(-1)
     for col, pk in enumerate(pubkeys):
         aff = _host_decompress(bytes(pk)) if len(pk) == 32 else None
         if aff is None:
-            tbl[:, col] = ident_entry
+            tbl[:, :, :, col] = ident_entry[None, None, :]
             continue
         ok[col] = True
         x, y = aff
         nx = (P - x) % P  # tables hold multiples of -A
         base = (nx, y, 1, nx * y % P)
-        rows: list[int] = []  # (row_index) parallel to entries
+        rows: list[tuple[int, int]] = []  # (window, digit) per entry
         entries: list[tuple[int, int, int, int]] = []
         for w in range(A_NWIN):
             e = _H_IDENT
             for d in range(16):
                 if d == 0:
-                    tbl[w * 16, col] = ident_entry
+                    tbl[w, 0, :, col] = ident_entry
                 else:
-                    rows.append(w * 16 + d)
+                    rows.append((w, d))
                     entries.append(e)
                 e = _hadd(e, base)
             for _ in range(A_WINDOW):
@@ -182,8 +195,44 @@ def host_build_key_tables(pubkeys) -> tuple[np.ndarray, np.ndarray]:
             zi = inv * prefix[i] % P
             inv = inv * entries[i][2] % P
             ex, ey = entries[i][0] * zi % P, entries[i][1] * zi % P
-            tbl[rows[i], col] = _precomp_limbs(ex, ey).reshape(-1)
+            w, d = rows[i]
+            tbl[w, d, :, col] = _precomp_limbs(ex, ey).reshape(-1)
     return tbl, ok
+
+
+_SB_TABLE: np.ndarray | None = None
+
+
+def sb_table_w4() -> np.ndarray:
+    """w=4 fixed-base comb: (64, 16, 60) int32; [w, j] holds
+    j * 2^(4w) * B in affine precomp form (ypx|ymx|t2d). Used by the
+    fused pallas path, whose per-step selection is a 16-way masked sum —
+    w=4 for BOTH scalars makes every one of its 128 steps identical
+    (the XLA path keeps the w=8 b_table and 96 steps instead)."""
+    global _SB_TABLE
+    if _SB_TABLE is not None:
+        return _SB_TABLE
+    entries = []
+    base = _B_EXT
+    for _ in range(SB_NWIN):
+        e = _H_IDENT
+        for _j in range(16):
+            entries.append(e)
+            e = _hadd(e, base)
+        for _ in range(4):
+            base = _hadd(base, base)
+    prefix = [1]
+    for pt in entries:
+        prefix.append(prefix[-1] * pt[2] % P)
+    inv = pow(prefix[-1], P - 2, P)
+    out = np.zeros((len(entries), 3 * NLIMBS), dtype=np.int32)
+    for i in reversed(range(len(entries))):
+        zi = inv * prefix[i] % P
+        inv = inv * entries[i][2] % P
+        x, y = entries[i][0] * zi % P, entries[i][1] * zi % P
+        out[i] = _precomp_limbs(x, y).reshape(-1)
+    _SB_TABLE = out.reshape(SB_NWIN, 16, 3 * NLIMBS)
+    return _SB_TABLE
 
 
 _B_TABLE: np.ndarray | None = None
@@ -340,12 +389,25 @@ def _build_tables_kernel(pub_bytes):
     return tbl, ok
 
 
+@jax.jit
+def _to_fused_layout(tbl):
+    """(1024, M, 60) int32 -> (64, 16, 60, M) int16 canonical table form.
+
+    Canonical entry limbs are in [0, 2^13) so int16 is lossless; halving
+    the bytes halves the fused kernel's dominant HBM stream (the table
+    is read once per verify launch)."""
+    m = tbl.shape[1]
+    return jnp.transpose(
+        tbl.reshape(A_NWIN, 16, m, 3 * NLIMBS), (0, 1, 3, 2)
+    ).astype(jnp.int16)
+
+
 def build_key_tables(pub_bytes: np.ndarray, chunk: int = 2048):
     """Build per-validator window tables on device, chunked to bound peak
     memory (each chunk materializes chunk*1024 extended points).
 
-    pub_bytes: (N, 32) uint8. Returns (tables (1024, N, 60) int32 on
-    device, ok (N,) bool on host)."""
+    pub_bytes: (N, 32) uint8. Returns (tables (64, 16, 60, N) int16 on
+    device — window, digit, limb, validator — and ok (N,) bool on host)."""
     n = pub_bytes.shape[0]
     tbls, oks = [], []
     for lo in range(0, n, chunk):
@@ -359,9 +421,9 @@ def build_key_tables(pub_bytes: np.ndarray, chunk: int = 2048):
                 [part, np.tile(_IDENT_PUB, (padded - m, 1))], axis=0
             )
         t, ok = _build_tables_kernel(jnp.asarray(part))
-        tbls.append(t[:, :m])
+        tbls.append(_to_fused_layout(t[:, :m]))
         oks.append(np.asarray(ok)[:m])
-    return jnp.concatenate(tbls, axis=1), np.concatenate(oks)
+    return jnp.concatenate(tbls, axis=3), np.concatenate(oks)
 
 
 # -- verification (device) ----------------------------------------------------
@@ -380,12 +442,17 @@ NSTEPS = B_NWIN + A_NWIN  # 96 mixed adds per signature
 def _select_entries(a_tables, s, h):
     """Gather-free operand selection -> (NSTEPS, B, 60) int32.
 
-    a_tables: (1024, N, 60) window-major; lane b uses table column
-    (b mod N), so one validator set verifies K stacked commits with
-    B = K*N lanes. Selection is 16 fused mask-multiplies per window —
-    the whole table streams through the VPU exactly once (a true gather
-    would be ~60x slower on TPU, measured).
+    a_tables: (64, 16, 60, N) canonical form (converted to the
+    window-major (1024, N, 60) int32 this path indexes); lane b uses
+    table column (b mod N), so one validator set verifies K stacked
+    commits with B = K*N lanes. Selection is 16 fused mask-multiplies
+    per window — the whole table streams through the VPU exactly once
+    (a true gather would be ~60x slower on TPU, measured).
     """
+    n_v = a_tables.shape[3]
+    a_tables = jnp.transpose(a_tables, (0, 1, 3, 2)).reshape(
+        A_NWIN * 16, n_v, 3 * NLIMBS
+    ).astype(jnp.int32)
     bsz = s.shape[0]
     n_vals = a_tables.shape[1]
     reps = bsz // n_vals
@@ -562,12 +629,195 @@ def _sum_entries_pallas(ent):
 
 from functools import partial  # noqa: E402
 
+# ---- fused select+accumulate pallas path ------------------------------------
+#
+# The materialized-entries pipeline above streams a (96, B, 60) int32
+# array through HBM twice (write at selection, read at accumulation) —
+# 7.6 GB of traffic at the K=16 x 10,240 bench shape on a device
+# measured at ~25 GB/s (docs/PLATFORM_NOTES.md). The fused kernel
+# removes that array entirely: each grid step selects its operands
+# INSIDE the kernel from the (int16, read-once) table block and feeds
+# them straight to the VMEM-resident mixed-add accumulator. To make
+# every step's selection the same cheap 16-way masked sum, the S comb
+# uses a w=4 fixed-base table too: 128 identical steps (64 S windows +
+# 64 h windows) instead of 96 asymmetric ones.
+#
+# Lane geometry: one grid tile covers V_TILE=128 validators x ALL K
+# stacked commits (lane planes are (8, 16*K) — the plane width scales
+# with K instead of adding commit-blocks to the grid), so each table
+# block serves every lane that ever needs it and the full table is read
+# EXACTLY ONCE per launch, independent of K. 128 is the smallest
+# validator block pallas can address on the table's minor axis, which
+# maximizes how much stacking a given VMEM budget allows.
+
+NSTEPS_W4 = 2 * SB_NWIN  # 128: steps 0..63 = S comb, 64..127 = h comb
+A_START = SB_NWIN
+V_TILE = 128
+MAX_FUSED_STACK = 64  # VMEM: acc+ent scratch = 140 * (8, 16K) planes
+
+
+def _digits_w4(s, h):
+    """(B, 32) int32 byte arrays -> (B, 128) int32 nibble-per-step."""
+    cols = []
+    for i in range(SB_NWIN):
+        cols.append((s[:, i // 2] >> (4 * (i % 2))) & 0xF)
+    for i in range(SB_NWIN):
+        cols.append((h[:, i // 2] >> (4 * (i % 2))) & 0xF)
+    return jnp.stack(cols, axis=-1)
+
+
+def _to_kernel_order(x, n_vals, v_tile, c_tile):
+    """Commit-major lanes (lane = c*N + v) -> fused-tile order: tile vb
+    holds its 128 validators' lanes COMMIT-major (lane = c*128 + v
+    within the tile), so a table column broadcasts to lane planes as a
+    native row-splat; pure reshape/transpose."""
+    b = x.shape[0]
+    k = b // n_vals
+    y = x.reshape((k, n_vals // v_tile, v_tile) + x.shape[1:])
+    y = jnp.transpose(y, (1, 0, 2) + tuple(range(3, y.ndim)))
+    return y.reshape((b,) + x.shape[1:])
+
+
+def _from_kernel_order(x, n_vals, v_tile, c_tile):
+    """Inverse of _to_kernel_order."""
+    b = x.shape[0]
+    k = b // n_vals
+    y = x.reshape((n_vals // v_tile, k, v_tile) + x.shape[1:])
+    y = jnp.transpose(y, (1, 0, 2) + tuple(range(3, y.ndim)))
+    return y.reshape((b,) + x.shape[1:])
+
+
+def _fused_tile_geometry(bsz: int, n_vals: int):
+    """(v_tile, c_tile) for the fused kernel, or None if the shape won't
+    tile. One tile = 128 validators x all K commits; the lane planes are
+    (8, 128*K/8), whose minor dim must stay a multiple of 128 — hence
+    K % 8 == 0 — and whose VMEM scratch bounds K at MAX_FUSED_STACK."""
+    k = bsz // n_vals
+    if n_vals % V_TILE == 0 and k % 8 == 0 and 8 <= k <= MAX_FUSED_STACK:
+        return V_TILE, k
+    return None
+
+
+def _make_fused_kernel(c_tile: int):
+    from jax.experimental import pallas as pl
+
+    w = V_TILE * c_tile // 8  # lane plane shape (8, w)
+
+    def kernel(sb_ref, atab_ref, dig_ref, out_ref, acc_ref, ent_ref):
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _():
+            rows = jax.lax.broadcasted_iota(jnp.int32, (80, 8, w), 0)
+            acc_ref[:] = jnp.where((rows == 20) | (rows == 40), 1, 0)
+
+        dig = dig_ref[0, 0]  # (8, w) int32 nibbles for this step
+        masks = [dig == d for d in range(16)]
+
+        @pl.when(t < A_START)
+        def _():
+            sb = sb_ref[0]  # (16, 60) int32 — shared by every lane
+            planes = []
+            for limb in range(60):
+                acc = jnp.zeros((8, w), jnp.int32)
+                for d in range(16):
+                    acc = acc + jnp.where(masks[d], sb[d, limb], 0)
+                planes.append(acc)
+            ent_ref[:] = jnp.stack(planes)
+
+        @pl.when(t >= A_START)
+        def _():
+            at = atab_ref[0].astype(jnp.int32)  # (16, 60, V_TILE)
+            reps = w // V_TILE  # commits per plane row (= c_tile/8)
+            planes = []
+            for limb in range(60):
+                acc = jnp.zeros((8, w), jnp.int32)
+                for d in range(16):
+                    col = at[d, limb]  # (V_TILE,) — this tile's validators
+                    # lanes are commit-major (lane = c*128 + v), so the
+                    # column expands by row-splat + minor concat — the
+                    # only vector reshapes Mosaic supports here
+                    bv = jnp.broadcast_to(col[None, :], (8, V_TILE))
+                    if reps > 1:
+                        bv = jnp.concatenate([bv] * reps, axis=1)
+                    acc = acc + jnp.where(masks[d], bv, 0)
+                planes.append(acc)
+            ent_ref[:] = jnp.stack(planes)
+
+        ent = ent_ref[:]
+        acc = tuple(
+            [acc_ref[20 * ci + i] for i in range(20)] for ci in range(4)
+        )
+        ypx = [ent[i] for i in range(20)]
+        ymx = [ent[20 + i] for i in range(20)]
+        t2d = [ent[40 + i] for i in range(20)]
+        nxt = _madd_planes(acc, ypx, ymx, t2d)
+        acc_ref[:] = jnp.stack([p for coord in nxt for p in coord])
+
+        @pl.when(t == NSTEPS_W4 - 1)
+        def _():
+            out_ref[0] = acc_ref[:]
+
+    return kernel
+
+
+def _fused_chain_pallas(a_tables, digits, v_tile, c_tile, interpret=False):
+    """a_tables (64,16,60,N) int16, digits (B,128) int32 kernel-order
+    -> extended acc coords, each (B, 20) int32 kernel-order."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bsz = digits.shape[0]
+    lanes_per_tile = v_tile * c_tile
+    tiles = bsz // lanes_per_tile  # == N / V_TILE validator blocks
+    w = lanes_per_tile // 8
+    # digits -> (tiles, NSTEPS_W4, 8, w) step-major planes so the
+    # pipeline hands each step its (8, w) nibble plane directly
+    dig = digits.reshape(tiles, 8, w, NSTEPS_W4)
+    dig = jnp.transpose(dig, (0, 3, 1, 2))
+    sb = jnp.asarray(sb_table_w4())
+
+    grid = (tiles, NSTEPS_W4)
+    out = pl.pallas_call(
+        _make_fused_kernel(c_tile),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 16, 60),
+                lambda i, t: (jnp.minimum(t, A_START - 1), 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 16, 60, v_tile),
+                lambda i, t: (jnp.maximum(t - A_START, 0), 0, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, 1, 8, w),
+                lambda i, t: (i, t, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 80, 8, w), lambda i, t: (i, 0, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((tiles, 80, 8, w), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((80, 8, w), jnp.int32),
+            pltpu.VMEM((60, 8, w), jnp.int32),
+        ],
+        interpret=interpret,
+    )(sb, a_tables, dig)
+    coords = out.reshape(tiles, 4, 20, 8, w)
+    coords = jnp.transpose(coords, (1, 0, 3, 4, 2)).reshape(4, bsz, NLIMBS)
+    return coords[0], coords[1], coords[2], coords[3]
+
 
 @partial(jax.jit, static_argnames=("impl",))
 def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
     """Batched verify against cached tables.
 
-    a_tables: (1024, N, 60) int32 from build_key_tables (window-major).
+    a_tables: (64, 16, 60, N) int16 from build_key_tables.
     s_bytes:  (B, 32) uint8, S little-endian (host-checked < L).
     h_bytes:  (B, 32) uint8, SHA512(R||A||M) mod L little-endian.
     r_bytes:  (B, 32) uint8, the signature's R encoding (sig[:32]).
@@ -577,17 +827,41 @@ def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
     same valset as B = K*N. Returns (B,) bool:
     encode([S]B + [h](-A)) == r_bytes, the same cofactorless
     byte-compare the reference's ed25519 performs. B must be a multiple
-    of N; the pallas path pads lanes to its 1024-lane tiles internally.
+    of N.
+
+    impl: "auto" picks the fused select+accumulate pallas kernel on TPU
+    whenever the (K, N) shape tiles (see _fused_tile_geometry), falling
+    back to the materialized-entries XLA scan elsewhere; "fused" forces
+    the fused kernel (interpreted off-TPU — slow, test-only); "pallas"
+    forces the materialized-entries pallas chain; "xla" the portable
+    scan.
     """
     s = s_bytes.astype(jnp.int32)
     h = h_bytes.astype(jnp.int32)
     r = r_bytes.astype(jnp.int32)
     bsz = s.shape[0]
+    n_vals = a_tables.shape[3]
+    on_tpu = jax.default_backend() == "tpu"
+    geom = _fused_tile_geometry(bsz, n_vals)
+
+    if impl == "fused" and geom is None:
+        raise ValueError(
+            f"impl='fused' but shape (B={bsz}, N={n_vals}) does not tile: "
+            f"needs N % {V_TILE} == 0 and K % 8 == 0, 8 <= K <= "
+            f"{MAX_FUSED_STACK}"
+        )
+    if geom is not None and (impl == "fused" or (impl == "auto" and on_tpu)):
+        v_tile, c_tile = geom
+        digits = _to_kernel_order(_digits_w4(s, h), n_vals, v_tile, c_tile)
+        x, y, z, _t = _fused_chain_pallas(
+            a_tables, digits, v_tile, c_tile, interpret=not on_tpu
+        )
+        r = _to_kernel_order(r, n_vals, v_tile, c_tile)
+        verdict = _finish_encode_compare(x, y, z, r)
+        return _from_kernel_order(verdict, n_vals, v_tile, c_tile)
 
     ent = _select_entries(a_tables, s, h)
-    use_pallas = impl == "pallas" or (
-        impl == "auto" and jax.default_backend() == "tpu"
-    )
+    use_pallas = impl == "pallas" or (impl == "auto" and on_tpu)
     if use_pallas:
         if bsz % _LANES != 0:
             # pad lanes with the identity precomp entry (ypx=1, ymx=1,
@@ -604,7 +878,11 @@ def verify_tables_kernel(a_tables, s_bytes, h_bytes, r_bytes, impl="auto"):
         x, y, z = x[:bsz], y[:bsz], z[:bsz]
     else:
         x, y, z, _t = _sum_entries_xla(ent)
+    return _finish_encode_compare(x, y, z, r)
 
+
+def _finish_encode_compare(x, y, z, r):
+    """Affine-normalize via one tree inversion, encode y, compare to R."""
     zinv = fe_batch_invert(fe_carry(z))
     x_aff = fe_canon(fe_mul(x, zinv))
     y_bytes = fe_to_bytes(fe_mul(y, zinv))

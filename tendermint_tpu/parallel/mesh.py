@@ -88,13 +88,13 @@ def sharded_tables_verify_and_tally(mesh: Mesh):
     (`ops.ed25519_tables`) — over the mesh.
 
     Sharding is along the VALIDATOR axis: each device holds 1/ndev of the
-    comb-table columns (tables (1024, N, 60) sharded on axis 1 — 2.5 GB at
-    N=10k splits to ~300 MB/chip) plus the lanes of its own validators for
-    all K stacked commits. Lane arrays must be in shard-major order (see
-    shard_lanes_validator_major); the >2/3 power tally is psum-reduced so
-    every shard holds the global total.
+    comb-table columns (tables (64, 16, 60, N) int16 sharded on the last
+    axis — 1.25 GB at N=10k splits to ~160 MB/chip) plus the lanes of its
+    own validators for all K stacked commits. Lane arrays must be in
+    shard-major order (see shard_lanes_validator_major); the >2/3 power
+    tally is psum-reduced so every shard holds the global total.
 
-    Inputs: tables (1024, N, 60) int32; s/h/r (K*N, 32) uint8; lane_ok
+    Inputs: tables (64, 16, 60, N) int16; s/h/r (K*N, 32) uint8; lane_ok
     (K*N,) bool — the host precheck AND the table build's key_ok tiled
     over commits (an invalid-key table column degrades to a forgeable
     check, so it MUST be masked in-device before the tally); powers
@@ -103,7 +103,7 @@ def sharded_tables_verify_and_tally(mesh: Mesh):
     Returns ((K*N,) bool shard-major verdicts, () int32 global tally).
     """
     lane_spec = P(BATCH_AXIS)
-    tbl_spec = P(None, BATCH_AXIS, None)
+    tbl_spec = P(None, None, None, BATCH_AXIS)
 
     @jax.jit
     @partial(

@@ -191,9 +191,26 @@ class TableBatchVerifier(DeviceBatchVerifier):
             ]
         tables, key_ok = self._tables_for(tuple(pubkeys))
         key_ok = key_ok & length_ok
-        s, h, r, precheck = prepare_commit_lanes(pubkeys, commits)
-        out = np.asarray(verify_tables_kernel(tables, s, h, r))
-        return (out & precheck & np.tile(key_ok, k)).reshape(k, n)
+        # The fused pallas path wants K in multiples of 8 (lane planes
+        # are (8, 16K)) up to MAX_FUSED_STACK; pad with absent-vote
+        # commits (verify False, masked by precheck) and chunk larger
+        # windows so every launch takes the fast path.
+        from tendermint_tpu.ops.ed25519_tables import MAX_FUSED_STACK
+
+        fusable = n % 128 == 0
+        out_rows = []
+        chunk = MAX_FUSED_STACK if fusable else len(commits)
+        for lo in range(0, k, chunk):
+            part = list(commits[lo : lo + chunk])
+            real = len(part)
+            if fusable and real % 8 != 0:
+                absent = ([None] * n, [None] * n)
+                part.extend([absent] * (8 - real % 8))
+            s, h, r, precheck = prepare_commit_lanes(pubkeys, part)
+            out = np.asarray(verify_tables_kernel(tables, s, h, r))
+            out = (out & precheck & np.tile(key_ok, len(part))).reshape(-1, n)
+            out_rows.append(out[:real])
+        return np.concatenate(out_rows, axis=0)
 
 
 _DEFAULT: BatchVerifier | None = None

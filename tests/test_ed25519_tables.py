@@ -115,6 +115,39 @@ class TestVerifyTablesKernel:
         assert out[0].all()
         assert list(out[1]) == [True, False, True]
 
+    def test_fused_kernel_matches_xla_path(self):
+        """The fused select+accumulate pallas kernel (interpret mode off
+        TPU) must agree with the portable XLA path, localize a planted
+        bad signature, and round-trip the lane permutation."""
+        n, k = 128, 8
+        privs = [gen_priv_key(bytes([i + 1]) * 32) for i in range(n)]
+        pubs = [p.pub_key.data for p in privs]
+        tables, ok = tb.host_build_key_tables(pubs)
+        assert ok.all()
+        commits = []
+        for c in range(k):
+            msgs = [b"c%d-%d" % (c, i) for i in range(n)]
+            sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+            commits.append((msgs, sigs))
+        _, s1 = commits[3]
+        s1[17] = s1[17][:5] + bytes([s1[17][5] ^ 1]) + s1[17][6:]
+        s, h, r, pre = tb.prepare_commit_lanes(pubs, commits)
+        assert tb._fused_tile_geometry(k * n, n) == (128, 8)
+        fused = np.asarray(tb.verify_tables_kernel(tables, s, h, r, impl="fused"))
+        xla = np.asarray(tb.verify_tables_kernel(tables, s, h, r, impl="xla"))
+        expect = np.ones(k * n, dtype=bool)
+        expect[3 * n + 17] = False
+        assert fused.tolist() == expect.tolist()
+        assert xla.tolist() == expect.tolist()
+
+    def test_host_build_matches_device_build(self):
+        _, pubs, _, _ = _keyed_batch(3, seed=77)
+        pub = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(3, 32)
+        dev_t, dev_ok = tb.build_key_tables(pub)
+        host_t, host_ok = tb.host_build_key_tables(pubs)
+        assert dev_ok.tolist() == host_ok.tolist()
+        np.testing.assert_array_equal(np.asarray(dev_t), host_t)
+
     def test_invalid_pubkey_rejected_at_build(self):
         _, pubs, msgs, sigs = _keyed_batch(2, seed=40)
         bad_pub = b"\xff" * 32  # not a curve point

@@ -1,0 +1,73 @@
+"""Micro-probes for the verify epilogue + memory system on the bench device."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops.ed25519_kernel import (
+    fe_canon,
+    fe_carry,
+    fe_invert,
+    fe_mul,
+    fe_to_bytes,
+)
+from tendermint_tpu.ops.ed25519_tables import fe_batch_invert
+
+
+def timeit(fn, *args, reps=3):
+    np.asarray(fn(*args))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        np.asarray(fn(*args))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    B = 163_840
+
+    one = jnp.asarray(rng.integers(1, 8192, size=(1, 20), dtype=np.int32))
+    t = timeit(jax.jit(lambda a: fe_invert(a).sum()), one)
+    print(f"fe_invert (1,20): {t*1e3:.1f}ms", flush=True)
+
+    z = jnp.asarray(rng.integers(1, 8192, size=(B, 20), dtype=np.int32))
+    t = timeit(jax.jit(lambda a: fe_batch_invert(a).sum()), z)
+    print(f"fe_batch_invert ({B},20): {t*1e3:.1f}ms", flush=True)
+
+    t = timeit(jax.jit(lambda a: fe_canon(a).sum()), z)
+    print(f"fe_canon ({B},20): {t*1e3:.1f}ms", flush=True)
+
+    t = timeit(jax.jit(lambda a: fe_to_bytes(a).sum()), z)
+    print(f"fe_to_bytes ({B},20): {t*1e3:.1f}ms", flush=True)
+
+    t = timeit(jax.jit(lambda a, b: fe_mul(a, b).sum()), z, z)
+    print(f"fe_mul ({B},20): {t*1e3:.1f}ms", flush=True)
+
+    t = timeit(jax.jit(lambda a, b: fe_carry(a + b).sum()), z, z)
+    print(f"fe_addc ({B},20): {t*1e3:.1f}ms", flush=True)
+
+    big = jnp.asarray(rng.integers(0, 100, size=(256 * 1024 * 1024,), dtype=np.int32))  # 1 GiB
+    t = timeit(jax.jit(lambda a: a.sum()), big)
+    print(f"sum 1GiB: {t*1e3:.1f}ms -> {1.0/t:.1f} GiB/s read", flush=True)
+
+    t = timeit(jax.jit(lambda a: (a + 1).sum()), big)
+    print(f"add+sum 1GiB: {t*1e3:.1f}ms", flush=True)
+
+    # dependent tiny-op chain cost (scan of 100 adds on (1,20))
+    def chain(a):
+        def step(c, _):
+            return fe_carry(c + c), None
+
+        out, _ = jax.lax.scan(step, a, None, length=100)
+        return out.sum()
+
+    t = timeit(jax.jit(chain), one)
+    print(f"100-step scan fe_carry (1,20): {t*1e3:.1f}ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Time the fused tables kernel on the bench device (random-valued
+tables — timing is value-independent)."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tendermint_tpu.ops.ed25519_tables import verify_tables_kernel
+
+N = 10_240
+
+
+def timeit(fn, *args, reps=3, **kw):
+    np.asarray(fn(*args, **kw))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        np.asarray(fn(*args, **kw))
+        best = min(best, time.time() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    tbl = jnp.asarray(
+        rng.integers(0, 8192, size=(64, 16, 60, N), dtype=np.int16)
+    )
+    for k in (16, 32, 64):
+        b = k * N
+        s = jnp.asarray(rng.integers(0, 256, size=(b, 32), dtype=np.int32).astype(np.uint8))
+        h = jnp.asarray(rng.integers(0, 256, size=(b, 32), dtype=np.int32).astype(np.uint8))
+        r = jnp.asarray(rng.integers(0, 256, size=(b, 32), dtype=np.int32).astype(np.uint8))
+        t = timeit(verify_tables_kernel, tbl, s, h, r, impl="fused")
+        print(f"K={k} B={b}: fused={t*1e3:.1f}ms -> {b/t:,.0f}/s", flush=True)
+
+
+if __name__ == "__main__":
+    main()

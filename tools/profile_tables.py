@@ -53,7 +53,7 @@ def finish(x, y, z, r):
 
 def main():
     rng = np.random.default_rng(0)
-    tbl = jnp.asarray(rng.integers(0, 8192, size=(1024, N, 60), dtype=np.int32))
+    tbl = jnp.asarray(rng.integers(0, 8192, size=(64, 16, 60, N), dtype=np.int16))
     # each stage reduced to a scalar on device so the d2h sync is tiny
     sel_small = jax.jit(lambda t, s, h: _select_entries(t, s, h).sum())
     sel_j = jax.jit(_select_entries)
