@@ -19,9 +19,16 @@ import threading
 import time
 from dataclasses import dataclass
 
+from tendermint_tpu.utils.flowrate import Monitor
 
 REQUEST_TIMEOUT_S = 15.0  # reference peerTimeout (pool.go:33)
-MAX_PENDING_PER_PEER = 20  # reference maxPendingRequestsPerPeer scaled
+MAX_PENDING_PER_PEER = 50  # reference maxPendingRequestsPerPeer (pool.go:31)
+MAX_PENDING = 1000  # reference maxTotalRequesters (pool.go:29-30)
+MIN_RECV_RATE = 10240  # bytes/s floor before eviction (pool.go:33)
+# how long a peer may hold pending requests before the rate floor
+# applies (stands in for the reference's e*minRecvRate REMA seeding,
+# which keeps fresh peers above the floor for the first seconds)
+MIN_RECV_GRACE_S = 4.0
 
 
 @dataclass
@@ -30,21 +37,39 @@ class _Request:
     sent_at: float
 
 
+class _PeerRec:
+    __slots__ = ("height", "monitor")
+
+    def __init__(self, height: int, time_fn) -> None:
+        self.height = height
+        self.monitor = Monitor(window_s=1.0, time_fn=time_fn)
+
+
 class BlockPool:
-    def __init__(self, start_height: int, max_pending: int = 64) -> None:
+    def __init__(
+        self,
+        start_height: int,
+        max_pending: int = MAX_PENDING,
+        time_fn=time.monotonic,
+    ) -> None:
         # next height we still need to hand to the executor
         self.height = start_height
         self._lock = threading.RLock()
         self._blocks: dict[int, tuple[object, str]] = {}  # height -> (block, peer)
         self._requests: dict[int, _Request] = {}
-        self._peers: dict[str, int] = {}  # peer_id -> advertised height
+        self._peers: dict[str, _PeerRec] = {}  # peer_id -> record
         self._max_pending = max_pending
+        self._time_fn = time_fn
 
     # -- peers ---------------------------------------------------------------
 
     def set_peer_height(self, peer_id: str, height: int) -> None:
         with self._lock:
-            self._peers[peer_id] = height
+            rec = self._peers.get(peer_id)
+            if rec is None:
+                self._peers[peer_id] = _PeerRec(height, self._time_fn)
+            else:
+                rec.height = height
 
     def remove_peer(self, peer_id: str) -> None:
         """Forget the peer; its in-flight requests become reassignable."""
@@ -55,7 +80,7 @@ class BlockPool:
 
     def max_peer_height(self) -> int:
         with self._lock:
-            return max(self._peers.values(), default=0)
+            return max((r.height for r in self._peers.values()), default=0)
 
     def num_peers(self) -> int:
         with self._lock:
@@ -73,18 +98,38 @@ class BlockPool:
         (`pool.go:115ff`), which is also the byzantine defense: a peer
         advertising a height it never serves would otherwise pin
         `max_peer_height` above reach and keep fast-sync from ever
-        completing. Freed heights reschedule to the remaining peers in
-        the same tick (reference `makeRequestersRoutine`)."""
-        now = now if now is not None else time.monotonic()
+        completing. A peer that DOES respond but below MIN_RECV_RATE
+        (10 kB/s, `pool.go:33,121-126`) is evicted too once its oldest
+        pending request is older than MIN_RECV_GRACE_S — a slow-drip
+        peer must not throttle the whole sync to its trickle. Freed
+        heights reschedule to the remaining peers in the same tick
+        (reference `makeRequestersRoutine`)."""
+        now = now if now is not None else self._time_fn()
         out: list[tuple[str, int]] = []
         evict: list[str] = []
         with self._lock:
             if not self._peers:
                 return [], []
+            oldest: dict[str, float] = {}
             for h, req in list(self._requests.items()):
                 if now - req.sent_at > REQUEST_TIMEOUT_S:
                     if req.peer_id in self._peers and req.peer_id not in evict:
                         evict.append(req.peer_id)
+                cur = oldest.get(req.peer_id)
+                if cur is None or req.sent_at < cur:
+                    oldest[req.peer_id] = req.sent_at
+            for peer_id, sent_at in oldest.items():
+                if peer_id in evict or peer_id not in self._peers:
+                    continue
+                if now - sent_at <= MIN_RECV_GRACE_S:
+                    continue
+                rate = self._peers[peer_id].monitor.rate
+                # rate == 0 means no response COMPLETED yet — a large
+                # first block may still be in flight, so only the hard
+                # 15 s timeout may evict then (the reference makes the
+                # same zero-rate exception, pool.go:122-123)
+                if rate != 0 and rate < MIN_RECV_RATE:
+                    evict.append(peer_id)
             for peer_id in evict:
                 self._peers.pop(peer_id, None)
                 for h in [
@@ -111,8 +156,8 @@ class BlockPool:
             if req.peer_id in loads:
                 loads[req.peer_id] += 1
         best, best_load = None, None
-        for p, max_h in self._peers.items():
-            if p == exclude or max_h < height:
+        for p, rec in self._peers.items():
+            if p == exclude or rec.height < height:
                 continue
             if loads[p] >= MAX_PENDING_PER_PEER:
                 continue
@@ -122,9 +167,10 @@ class BlockPool:
 
     # -- data ------------------------------------------------------------------
 
-    def add_block(self, peer_id: str, block) -> bool:
+    def add_block(self, peer_id: str, block, size: int = 0) -> bool:
         """Accept a response only for a height we requested from that
-        peer (reference `AddBlock pool.go:203-224`)."""
+        peer (reference `AddBlock pool.go:203-224`); `size` (the wire
+        payload bytes) feeds the peer's recv-rate monitor."""
         height = block.header.height
         with self._lock:
             req = self._requests.get(height)
@@ -132,6 +178,9 @@ class BlockPool:
                 return False
             del self._requests[height]
             self._blocks[height] = (block, peer_id)
+            rec = self._peers.get(peer_id)
+            if rec is not None and size > 0:
+                rec.monitor.update(size)
         return True
 
     def peek(self, n: int) -> list:

@@ -140,7 +140,7 @@ class BlockchainReactor(Reactor):
             else:
                 peer.try_send(BLOCKCHAIN_CHANNEL, _enc(_MSG_NO_BLOCK, arg))
         elif kind == "block_response":
-            self.pool.add_block(peer.id, arg)
+            self.pool.add_block(peer.id, arg, size=len(payload))
         elif kind == "status_request":
             peer.try_send(
                 BLOCKCHAIN_CHANNEL, _enc(_MSG_STATUS_RESPONSE, self.store.height)

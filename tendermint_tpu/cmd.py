@@ -1,4 +1,6 @@
-"""CLI: init / node / testnet / show_validator / version
+"""CLI: init / node / testnet / show_validator / gen_validator /
+replay / replay_console / reset_all / reset_priv_validator /
+probe_upnp / wal2json / cut_wal_until / version
 (reference `cmd/tendermint/main.go:14-37` + `commands/`).
 
 Run as `python -m tendermint_tpu <command> [--home DIR] ...`.
@@ -135,6 +137,92 @@ def _cmd_version(args) -> int:
     return 0
 
 
+def _cmd_gen_validator(args) -> int:
+    """Print a freshly generated validator keypair as JSON (reference
+    `commands/gen_validator.go`). Does NOT touch any file."""
+    import json as _json
+
+    from tendermint_tpu.crypto import gen_priv_key
+
+    pk = gen_priv_key()
+    print(
+        _json.dumps(
+            {
+                "address": pk.pub_key.address.hex(),
+                "pub_key": pk.pub_key.data.hex(),
+                "priv_key_seed": pk.seed.hex(),
+                "last_height": 0,
+                "last_round": 0,
+                "last_step": 0,
+                "last_signature": "",
+                "last_signbytes": "",
+            },
+            indent=2,
+            sort_keys=True,
+        )
+    )
+    return 0
+
+
+def _reset_priv_validator(path: str) -> None:
+    from tendermint_tpu.types.priv_validator import PrivValidatorFS
+
+    if os.path.exists(path):
+        PrivValidatorFS.load(path).reset()
+        print(f"reset priv validator sign state: {path}")
+    else:
+        PrivValidatorFS.load_or_gen(path)
+        print(f"generated priv validator: {path}")
+
+
+def _cmd_reset_priv_validator(args) -> int:
+    """(unsafe) Forget the validator's last-sign state — double-sign
+    protection goes with it (reference `commands/reset_priv_validator.go`).
+    Testnets only."""
+    from tendermint_tpu.config import load_config
+
+    _reset_priv_validator(load_config(args.home).priv_validator_path())
+    return 0
+
+
+def _cmd_reset_all(args) -> int:
+    """(unsafe) Remove all chain data + WALs and reset the validator
+    (reference `commands/reset_priv_validator.go` resetAll)."""
+    import shutil
+
+    from tendermint_tpu.config import load_config
+
+    cfg = load_config(args.home)
+    data_dir = os.path.dirname(cfg.db_path("state"))
+    for victim in (data_dir, cfg.mempool_wal_path()):
+        if os.path.isdir(victim):
+            shutil.rmtree(victim)
+            print(f"removed {victim}")
+    _reset_priv_validator(cfg.priv_validator_path())
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    """Replay the consensus WAL through a fresh state machine — all at
+    once (`replay`) or interactively (`replay_console`); reference
+    `consensus/replay_file.go`, `commands/replay.go`."""
+    from tendermint_tpu.config import load_config
+    from tendermint_tpu.consensus.replay_console import (
+        Playback,
+        make_replay_cs_factory,
+    )
+
+    cfg = load_config(args.home)
+    wal = args.wal or cfg.wal_path()
+    pb = Playback(make_replay_cs_factory(cfg), wal)
+    if args.console:
+        pb.console()
+    else:
+        n = pb.run_all()
+        print(f"replayed {n} records; final state: {pb.round_state('short')}")
+    return 0
+
+
 def _cmd_wal2json(args) -> int:
     """Dump a consensus WAL as JSON lines (reference
     `scripts/wal2json/main.go:19-50`)."""
@@ -252,6 +340,34 @@ def main(argv=None) -> int:
     p = sub.add_parser("probe_upnp", help="test UPnP gateway port mapping")
     p.add_argument("--port", type=int, default=46656)
     p.set_defaults(fn=_cmd_probe_upnp)
+
+    p = sub.add_parser("gen_validator", help="generate a validator keypair")
+    p.set_defaults(fn=_cmd_gen_validator)
+
+    p = sub.add_parser(
+        "reset_priv_validator",
+        help="(unsafe) reset the validator's sign state",
+    )
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.set_defaults(fn=_cmd_reset_priv_validator)
+
+    p = sub.add_parser(
+        "reset_all", help="(unsafe) wipe chain data + reset the validator"
+    )
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.set_defaults(fn=_cmd_reset_all)
+
+    p = sub.add_parser("replay", help="replay the consensus WAL")
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.add_argument("--wal", default="", help="WAL path (default: the home's)")
+    p.set_defaults(fn=_cmd_replay, console=False)
+
+    p = sub.add_parser(
+        "replay_console", help="step the consensus WAL interactively"
+    )
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    p.add_argument("--wal", default="", help="WAL path (default: the home's)")
+    p.set_defaults(fn=_cmd_replay, console=True)
 
     p = sub.add_parser("version", help="print the version")
     p.set_defaults(fn=_cmd_version)

@@ -49,6 +49,9 @@ class P2PConfig:
     persistent_peers: str = ""
     secret_connections: bool = True  # X25519+AEAD STS on every peer link
     pex: bool = True  # peer-exchange discovery (addrbook + PEX reactor)
+    # ask the ABCI app to vet peers via Query("/p2p/filter/...") before
+    # admission (reference node/node.go:259-281, config FilterPeers)
+    filter_peers: bool = False
     max_num_peers: int = 50
     pex_ensure_interval_s: float = 30.0  # reference ensurePeersPeriod
     send_rate: int = 512000  # bytes/s (flow limits live in MConnection)
@@ -81,6 +84,9 @@ class Config:
 
     def priv_validator_path(self) -> str:
         return os.path.join(self.home, self.base.priv_validator_file)
+
+    def node_key_path(self) -> str:
+        return os.path.join(self.home, "node_key.json")
 
     def db_path(self, name: str) -> str:
         return os.path.join(self.home, self.base.db_dir, f"{name}.db")

@@ -18,6 +18,9 @@ class ConsensusConfig:
     skip_timeout_commit: bool = False
     create_empty_blocks: bool = True
     create_empty_blocks_interval: int = 0  # seconds
+    # proposer liveness ping cadence while waiting for txs in
+    # no-empty-blocks mode (reference proposalHeartbeatIntervalSeconds)
+    proposal_heartbeat_interval: float = 2.0
     max_block_size_txs: int = 10_000
     wal_light: bool = False
 

@@ -25,10 +25,13 @@ from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
 from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.heartbeat import Heartbeat
 from tendermint_tpu.types.part_set import Part, PartSetHeader
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
 from tendermint_tpu.utils.bit_array import BitArray
+from tendermint_tpu.utils.log import kv as _log_kv, logger as _log
+import logging as _logging
 
 STATE_CHANNEL = 0x20
 DATA_CHANNEL = 0x21
@@ -44,6 +47,7 @@ _MSG_VOTE = 0x06
 _MSG_HAS_VOTE = 0x07
 _MSG_VOTE_SET_MAJ23 = 0x08
 _MSG_VOTE_SET_BITS = 0x09
+_MSG_PROPOSAL_HEARTBEAT = 0x20  # reference msgTypeProposalHeartbeat
 
 _GOSSIP_SLEEP_S = 0.05  # reference peerGossipSleepDuration=100ms, scaled down
 _MAJ23_SLEEP_S = 0.5  # reference peerQueryMaj23SleepDuration=2s, scaled
@@ -193,6 +197,23 @@ class VoteSetMaj23Message:
 
 
 @dataclass(frozen=True)
+class ProposalHeartbeatMessage:
+    """Signed proposer liveness ping, gossiped while the chain idles in
+    no-empty-blocks mode (reference `:1149` msgTypeProposalHeartbeat,
+    `reactor.go:219-220,338-343`)."""
+
+    heartbeat: Heartbeat
+
+    def encode(self) -> bytes:
+        return (
+            Writer()
+            .uvarint(_MSG_PROPOSAL_HEARTBEAT)
+            .bytes(self.heartbeat.encode())
+            .build()
+        )
+
+
+@dataclass(frozen=True)
 class VoteSetBitsMessage:
     """Answer to a Maj23 claim: which of those votes we have (`:1922`)."""
 
@@ -250,6 +271,8 @@ def decode_message(payload: bytes):
             BlockID.decode_from(r),
             _r_bits(r),
         )
+    if tag == _MSG_PROPOSAL_HEARTBEAT:
+        return ProposalHeartbeatMessage(Heartbeat.decode(r.bytes()))
     raise ValueError(f"unknown consensus message tag {tag:#x}")
 
 
@@ -449,6 +472,9 @@ class ConsensusReactor(Reactor):
         es.add_listener(
             "reactor", ev.EVENT_COMPLETE_PROPOSAL, self._on_complete_proposal
         )
+        es.add_listener(
+            "reactor", ev.EVENT_PROPOSAL_HEARTBEAT, self._on_proposal_heartbeat
+        )
         if not self.fast_sync:
             self.cs.start()
 
@@ -595,6 +621,15 @@ class ConsensusReactor(Reactor):
         elif chan_id == VOTE_SET_BITS_CHANNEL:
             self._receive_vote_set_bits(peer, ps, msg)
 
+    def _on_proposal_heartbeat(self, hb: Heartbeat) -> None:
+        """Broadcast our own heartbeats to every peer (reference
+        `broadcastProposalHeartbeatMessage reactor.go:344-349`)."""
+        if not self._running or self.switch is None:
+            return
+        self.switch.broadcast(
+            STATE_CHANNEL, ProposalHeartbeatMessage(hb).encode()
+        )
+
     def _receive_state(self, peer: Peer, ps: PeerState, msg) -> None:
         if isinstance(msg, NewRoundStepMessage):
             ps.apply_new_round_step(msg)
@@ -603,6 +638,30 @@ class ConsensusReactor(Reactor):
         elif isinstance(msg, HasVoteMessage):
             n = len(self.cs.get_round_state().validators)
             ps.set_has_vote(msg.height, msg.round, msg.type, msg.index, n)
+        elif isinstance(msg, ProposalHeartbeatMessage):
+            # Verify and log; do NOT re-fire the local event — only a
+            # node's own heartbeats are broadcast (re-firing would gossip
+            # forever between peers). Reference `reactor.go:219-222`.
+            hb = msg.heartbeat
+            rs = self.cs.get_round_state()
+            val = None
+            if 0 <= hb.validator_index < len(rs.validators):
+                val = rs.validators.validators[hb.validator_index]
+            if val is None or val.address != hb.validator_address:
+                return
+            if not val.pub_key.verify(
+                hb.sign_bytes(self.cs.state.chain_id), hb.signature
+            ):
+                return
+            _log_kv(
+                _log("consensus"),
+                _logging.DEBUG,
+                "peer proposal heartbeat",
+                peer=peer.id[:12],
+                height=hb.height,
+                round=hb.round,
+                seq=hb.sequence,
+            )
         elif isinstance(msg, VoteSetMaj23Message):
             rs = self.cs.get_round_state()
             if rs.height != msg.height or rs.votes is None:
@@ -868,11 +927,21 @@ class ConsensusReactor(Reactor):
     def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
         """Periodically tell peers which vote sets we see majorities in so
         they can send us exactly the votes we miss (reference `:652-739`)."""
+        last_hrs = None
         while self._peer_alive(peer):
             time.sleep(_MAJ23_SLEEP_S)
             rs = self.cs.get_round_state()
             prs = ps.snapshot()
-            ps.clear_height_bits(prs.height)
+            # Clear the sent-vote mirror ONLY when the peer has made no
+            # height/round/step progress for a full tick — a send that
+            # raced its transition may have been dropped, so retrying is
+            # the liveness insurance. Clearing unconditionally (as the
+            # old code did) re-sent every vote of the height to every
+            # peer twice a second in steady state.
+            cur = (prs.height, prs.round, prs.step)
+            if cur == last_hrs:
+                ps.clear_height_bits(prs.height)
+            last_hrs = cur
             if rs.votes is None or rs.height != prs.height:
                 continue
             for round_ in (rs.round, prs.round, prs.proposal_pol_round):

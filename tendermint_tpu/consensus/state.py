@@ -41,6 +41,7 @@ from tendermint_tpu.state.state import State
 from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.block import Block, Commit
 from tendermint_tpu.types.block_id import BlockID
+from tendermint_tpu.types.heartbeat import Heartbeat
 from tendermint_tpu.types.errors import (
     ErrDoubleSign,
     FatalConsensusError,
@@ -434,8 +435,51 @@ class ConsensusState:
                     round_,
                     RoundStepType.NEW_ROUND,
                 )
+            if self.priv_validator is not None:
+                # signed liveness pings while the chain idles (reference
+                # `proposalHeartbeat consensus/state.go:707-738`); the
+                # reactor gossips them, WS subscribers observe them
+                threading.Thread(
+                    target=self._proposal_heartbeat,
+                    args=(height, round_),
+                    daemon=True,
+                ).start()
             return
         self._enter_propose(height, round_)
+
+    def _proposal_heartbeat(self, height: int, round_: int) -> None:
+        addr = self.priv_validator.address
+        idx = -1
+        with self._mtx:
+            for i, v in enumerate(self.validators):
+                if v.address == addr:
+                    idx = i
+                    break
+            chain_id = self.state.chain_id
+        if idx < 0:
+            # not in the validator set: nothing to prove liveness for,
+            # and the wire encoding (uvarint index) can't carry -1
+            return
+        sequence = 0
+        while self._running:
+            rs = self.get_round_state()
+            if (
+                rs.height > height
+                or rs.round > round_
+                or rs.step > RoundStepType.NEW_ROUND
+            ):
+                return
+            hb = Heartbeat(
+                validator_address=addr,
+                validator_index=idx,
+                height=rs.height,
+                round=rs.round,
+                sequence=sequence,
+            )
+            hb = self.priv_validator.sign_heartbeat(chain_id, hb)
+            self.event_switch.fire(ev.EVENT_PROPOSAL_HEARTBEAT, hb)
+            sequence += 1
+            time_mod.sleep(self.config.proposal_heartbeat_interval)
 
     def _enter_propose(self, height: int, round_: int) -> None:
         if height != self.height or round_ < self.round or (

@@ -21,6 +21,7 @@ from tendermint_tpu.consensus.ticker import TimeoutTicker
 from tendermint_tpu.db.kv import DB, SQLiteDB
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
+from tendermint_tpu.p2p.node_key import NodeKey
 from tendermint_tpu.p2p.peer import NodeInfo
 from tendermint_tpu.p2p.switch import Switch
 from tendermint_tpu.p2p.tcp import TcpListener, dial
@@ -46,6 +47,7 @@ class Node:
         client_creator=None,
         db_provider=None,
         verifier=None,
+        node_key=None,
     ) -> None:
         self.config = config
         cfg = config
@@ -69,7 +71,16 @@ class Node:
             if priv_validator is not None
             else PrivValidatorFS.load_or_gen(cfg.priv_validator_path())
         )
-        self.node_id = self.priv_validator.address.hex()
+        # Dedicated transport identity, NOT the validator signing key:
+        # keeps consensus signatures and p2p handshake signatures under
+        # different keys and lets remote-signer topologies (validator key
+        # never on this host) still run encrypted p2p.
+        self.node_key = (
+            node_key
+            if node_key is not None
+            else NodeKey.load_or_gen(cfg.node_key_path())
+        )
+        self.node_id = self.node_key.node_id
 
         # state + stores
         self.state_db = _db("state")
@@ -156,6 +167,26 @@ class Node:
         )
         self.switch.send_rate = cfg.p2p.send_rate
         self.switch.recv_rate = cfg.p2p.recv_rate
+        if cfg.p2p.filter_peers:
+            # ABCI-driven peer admission (reference node/node.go:259-281):
+            # the app vets each peer via Query before registration. The
+            # reference filters on addr and pubkey; node ids here are the
+            # transport identity (address of the node key), so the id
+            # filter is the pubkey filter's analog.
+            def _abci_peer_filter(remote_info, remote_addr):
+                # addr filter uses the SOCKET's remote address (the peer
+                # cannot choose it); self-reported listen_addr would let
+                # a banned host dodge the blocklist
+                paths = [f"/p2p/filter/id/{remote_info.node_id}"]
+                if remote_addr:
+                    paths.insert(0, f"/p2p/filter/addr/{remote_addr}")
+                for path in paths:
+                    res = self.app_conns.query.query_sync(path, b"")
+                    if not res.is_ok:
+                        return f"app rejected peer ({path}): code {res.code}"
+                return None
+
+            self.switch.peer_filter = _abci_peer_filter
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
@@ -186,22 +217,13 @@ class Node:
 
     @property
     def _node_key(self):
-        """Long-lived node identity key for SecretConnection handshakes
-        (the priv validator's key — node_id is derived from it, so the
-        encrypted transport's identity check pins peers to their ids).
-        Raises rather than silently downgrading to plaintext when the
-        configured encryption has no key to run with (e.g. a remote
-        signer that never exposes the private key — set
-        p2p.secret_connections=false explicitly for that topology)."""
+        """Long-lived node identity key for SecretConnection handshakes —
+        the dedicated node_key.json key (node_id is derived from it, so
+        the encrypted transport's identity check pins peers to their
+        ids), never the validator signing key."""
         if not self.config.p2p.secret_connections:
             return None
-        key = getattr(self.priv_validator, "node_key", None)
-        if key is None:
-            raise ValueError(
-                "p2p.secret_connections is enabled but the priv validator "
-                "exposes no private key for the transport handshake"
-            )
-        return key
+        return self.node_key.priv_key
 
     def start(self) -> None:
         if self.config.p2p.laddr:
@@ -239,12 +261,18 @@ class Node:
             self.grpc = GRPCBroadcastServer(self, self.config.rpc.grpc_laddr)
             self.grpc.start()
         for seed in filter(None, self.config.p2p.seeds.split(",")):
-            try:
-                dial(self.switch, seed.strip(), priv_key=self._node_key)
-            except Exception:
-                import logging
+            self.dial_seed(seed.strip())
 
-                logging.getLogger(__name__).warning("dial %s failed", seed)
+    def dial_seed(self, addr: str) -> None:
+        """Dial one seed address; failures are logged, not raised (the
+        reference dials seeds with per-seed error handling). Also the
+        dial_seeds RPC's worker."""
+        try:
+            dial(self.switch, addr, priv_key=self._node_key)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning("dial %s failed", addr)
 
     def stop(self) -> None:
         if self.grpc is not None:

@@ -60,6 +60,12 @@ class Switch:
         # per-peer flow caps, bytes/s (0 = unlimited; reference 500 kB/s)
         self.send_rate = 0
         self.recv_rate = 0
+        # optional admission hook (ABCI peer filters, reference
+        # `node/node.go:259-281`): fn(remote_info, remote_addr) -> error
+        # string or None; a non-None return rejects the peer before
+        # registration. remote_addr is the SOCKET's remote address ("" on
+        # in-memory transports) — never the peer's self-reported one.
+        self.peer_filter = None
 
     @property
     def node_info(self) -> NodeInfo:
@@ -124,6 +130,13 @@ class Switch:
         if reason is not None:
             endpoint.close()
             raise ValueError(f"incompatible peer: {reason}")
+        if self.peer_filter is not None:
+            reason = self.peer_filter(
+                remote_info, getattr(endpoint, "remote_addr", "")
+            )
+            if reason is not None:
+                endpoint.close()
+                raise ValueError(f"peer filtered: {reason}")
         with self._mtx:
             if remote_info.node_id in self._peers:
                 endpoint.close()

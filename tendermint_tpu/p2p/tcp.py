@@ -3,9 +3,11 @@
 Frames are 4-byte big-endian length-prefixed (carrying the same
 channel-multiplexed payloads as the in-memory pipe). Connecting sides
 exchange NodeInfo as the first frame (version/chain-id compat handshake
-— reference `p2p/peer.go` handshake; the reference's SecretConnection
-encryption layer is a documented gap here, acceptable for trusted
-networks / local testnets).
+— reference `p2p/peer.go`). When a `priv_key` is supplied (config
+p2p.secret_connections, the default), every link is wrapped in the
+SecretConnection STS handshake (`p2p/secret.py`) before the NodeInfo
+exchange, and the peer's claimed node_id must match the address of its
+authenticated identity key (see `_check_identity`).
 """
 
 from __future__ import annotations
@@ -28,6 +30,13 @@ class TcpEndpoint:
         self._sock = sock
         self._wlock = threading.Lock()
         self._closed = threading.Event()
+        try:
+            host, port = sock.getpeername()[:2]
+            # the socket's REAL remote address — peer filters must use
+            # this, never the peer's self-reported listen_addr
+            self.remote_addr = f"{host}:{port}"
+        except OSError:
+            self.remote_addr = ""
         sock.settimeout(None)
 
     def send(self, data: bytes, timeout: float = 10.0) -> bool:

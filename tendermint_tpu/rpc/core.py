@@ -140,15 +140,50 @@ def make_routes(node) -> dict:
             c = node.block_store.load_seen_commit(int(height))
         if c is None:
             raise RPCError(-32000, f"no commit for height {height}")
-        return {
+        meta = node.block_store.load_block_meta(int(height))
+        out = {
             "canonical": not seen,
             "commit": {
                 "height": c.height(),
                 "round": c.round(),
-                "block_id": c.block_id.hash.hex(),
+                "block_id": {
+                    "hash": c.block_id.hash.hex(),
+                    "parts": {
+                        "total": c.block_id.parts_header.total,
+                        "hash": c.block_id.parts_header.hash.hex(),
+                    },
+                },
                 "signatures": sum(1 for p in c.precommits if p is not None),
+                # full precommits (null = absent vote) so external light
+                # clients can re-verify — the reference's ResultCommit
+                # carries the complete SignedHeader
+                # (`rpc/core/blocks.go` Commit)
+                "precommits": [
+                    None
+                    if v is None
+                    else {
+                        "validator_address": v.validator_address.hex(),
+                        "validator_index": v.validator_index,
+                        "height": v.height,
+                        "round": v.round,
+                        "timestamp": v.timestamp,
+                        "type": v.type,
+                        "block_id": {
+                            "hash": v.block_id.hash.hex(),
+                            "parts": {
+                                "total": v.block_id.parts_header.total,
+                                "hash": v.block_id.parts_header.hash.hex(),
+                            },
+                        },
+                        "signature": v.signature.hex(),
+                    }
+                    for v in c.precommits
+                ],
             },
         }
+        if meta is not None:
+            out["header"] = _header_json(meta.header)
+        return out
 
     def validators(height: int | None = None) -> dict:
         h = int(height) if height is not None else node.current_state.last_block_height + 1
@@ -385,7 +420,29 @@ def make_routes(node) -> dict:
             ],
         }
 
+    def dial_seeds(seeds: str = "") -> dict:
+        """UnsafeDialSeeds (reference `rpc/core/net.go:57-69`): dial a
+        comma-separated seed list in the background."""
+        lst = [s.strip() for s in str(seeds).split(",") if s.strip()]
+        if not lst:
+            raise RPCError(-32602, "no seeds provided")
+        import threading
+
+        for seed in lst:
+            threading.Thread(
+                target=node.dial_seed, args=(seed,), daemon=True
+            ).start()
+        return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+    def unsafe_flush_mempool() -> dict:
+        """Drop every pending tx (reference `rpc/core/mempool.go`
+        UnsafeFlushMempool, route `routes.go:39`)."""
+        node.mempool.flush()
+        return {"result": "flushed"}
+
     routes_unsafe = {
+        "dial_seeds": dial_seeds,
+        "unsafe_flush_mempool": unsafe_flush_mempool,
         "unsafe_start_cpu_profiler": unsafe_start_cpu_profiler,
         "unsafe_stop_cpu_profiler": unsafe_stop_cpu_profiler,
         "unsafe_dump_threads": unsafe_dump_threads,

@@ -3,8 +3,8 @@
 Reference `rpc/lib/server/handlers.go:101` (JSON-RPC over POST) and
 `:234` (GET with query params). Handlers are plain callables registered
 by name with keyword params; results must be JSON-serializable dicts.
-WebSocket event subscription is a known gap (the event bus exists;
-transport pending).
+WebSocket event subscription lives in `rpc/websocket.py` (RFC 6455
+upgrade served off this same listener).
 """
 
 from __future__ import annotations

@@ -103,6 +103,14 @@ def event_to_json(event: str, data) -> dict:
     if header is not None and hasattr(header, "height"):
         out["height"] = header.height
         return out
+    if hasattr(data, "sequence") and hasattr(data, "validator_address"):
+        out.update(
+            height=data.height,
+            round=data.round,
+            sequence=data.sequence,
+            validator=data.validator_address.hex(),
+        )
+        return out  # ProposalHeartbeat
     vote = getattr(data, "vote", None)
     if vote is not None:
         out.update(

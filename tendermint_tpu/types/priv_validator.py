@@ -78,13 +78,6 @@ class PrivValidator:
     def _save(self) -> None:
         pass
 
-    @property
-    def node_key(self) -> PrivKey | None:
-        """The long-lived identity key for transport handshakes
-        (SecretConnection), when this validator can expose one. Remote
-        signers (HSMs) return None — configure the node accordingly."""
-        return getattr(self._signer, "_priv_key", None)
-
     # -- HRS guard -----------------------------------------------------------
 
     def _check_hrs(self, height: int, round_: int, step: int, sign_bytes: bytes) -> bytes | None:

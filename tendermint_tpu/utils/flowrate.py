@@ -13,14 +13,20 @@ class Monitor:
     optional limit: `throttle()` sleeps just enough to keep the average
     under the cap (the reference's blocking `Limit` mode)."""
 
-    def __init__(self, limit_bytes_per_s: int = 0, window_s: float = 1.0) -> None:
+    def __init__(
+        self,
+        limit_bytes_per_s: int = 0,
+        window_s: float = 1.0,
+        time_fn=time.monotonic,
+    ) -> None:
         self.limit = limit_bytes_per_s
         self._window = window_s
+        self._time = time_fn
         self._lock = threading.Lock()
         self._total = 0
         self._rate = 0.0
         self._bucket = 0
-        self._bucket_start = time.monotonic()
+        self._bucket_start = self._time()
 
     def update(self, n: int) -> None:
         with self._lock:
@@ -29,7 +35,7 @@ class Monitor:
             self._roll()
 
     def _roll(self) -> None:
-        now = time.monotonic()
+        now = self._time()
         elapsed = now - self._bucket_start
         if elapsed >= self._window:
             inst = self._bucket / elapsed
@@ -48,7 +54,7 @@ class Monitor:
         """Bytes/s over the recent window."""
         with self._lock:
             self._roll()
-            now = time.monotonic()
+            now = self._time()
             elapsed = now - self._bucket_start
             if elapsed > 0.05:
                 inst = self._bucket / elapsed
@@ -61,7 +67,7 @@ class Monitor:
         if self.limit <= 0:
             return
         with self._lock:
-            now = time.monotonic()
+            now = self._time()
             elapsed = now - self._bucket_start
             ahead = self._bucket / self.limit - elapsed
         if ahead > 0:

@@ -56,6 +56,42 @@ class TestBlockPool:
         assert {h for _, h in reqs2} == set(range(1, 5))
         assert pool.num_peers() == 1
 
+    def test_slow_drip_peer_evicted_below_min_recv_rate(self):
+        """A peer that keeps responding but below the 10 kB/s floor is
+        evicted (reference pool.go:33,121-126) while the healthy peer
+        keeps the sync going — a trickle must not throttle the window."""
+        import types
+
+        clock = [0.0]
+        pool = BlockPool(start_height=1, max_pending=8, time_fn=lambda: clock[0])
+        pool.set_peer_height("slow", 100)
+        pool.set_peer_height("fast", 100)
+        reqs, evict = pool.schedule_requests(now=clock[0])
+        assert not evict and {p for p, _ in reqs} == {"slow", "fast"}
+        by_peer = {}
+        for p, h in reqs:
+            by_peer.setdefault(p, []).append(h)
+
+        def blk(h):
+            return types.SimpleNamespace(
+                header=types.SimpleNamespace(height=h)
+            )
+
+        # 5 seconds pass: fast delivers all its blocks at ~40 kB/s,
+        # slow drips one tiny response (~20 B/s) — alive, but a trickle
+        for t in (1.0, 2.0, 3.0, 4.0, 5.0):
+            clock[0] = t
+            for h in by_peer["fast"]:
+                pool.add_block("fast", blk(h), size=8000)
+            by_peer["fast"] = []
+        pool.add_block("slow", blk(by_peer["slow"][0]), size=100)
+
+        reqs2, evict2 = pool.schedule_requests(now=clock[0])
+        assert evict2 == ["slow"]
+        assert pool.num_peers() == 1
+        # the freed heights rescheduled to the healthy peer in-tick
+        assert reqs2 and {p for p, _ in reqs2} == {"fast"}
+
     def test_rejects_unrequested_blocks(self):
         import types
 

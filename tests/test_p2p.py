@@ -169,6 +169,32 @@ class TestSwitch:
             s0.stop()
             s1.stop()
 
+    def test_peer_filter_rejects_before_registration(self):
+        """ABCI-style peer admission (reference node/node.go:259-281):
+        a non-None filter verdict rejects the peer pre-registration."""
+        s0 = _mk_switch(0)
+        s1 = _mk_switch(1)
+        s1.peer_filter = (
+            lambda info, addr: "blocklisted" if info.node_id == "node0" else None
+        )
+        s0.start()
+        s1.start()
+        try:
+            with pytest.raises(ValueError, match="peer filtered: blocklisted"):
+                connect_switches(s0, s1)
+            assert s1.n_peers() == 0
+            # the filter runs per-peer: an allowed node still connects
+            s2 = _mk_switch(2)
+            s2.start()
+            try:
+                connect_switches(s2, s1)
+                assert s1.n_peers() == 1
+            finally:
+                s2.stop()
+        finally:
+            s0.stop()
+            s1.stop()
+
     def test_raising_reactor_drops_peer(self):
         s0, s1 = make_connected_switches(2, _mk_switch)
         try:

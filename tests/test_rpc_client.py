@@ -50,6 +50,37 @@ class TestClients:
         g = c.genesis()["genesis"]
         assert g["chain_id"] == "client-test"
 
+    def test_node_provider_feeds_light_client(self, solo_node):
+        """An external light client certifies straight off a live node's
+        RPC (reference certifiers/client/provider.go): NodeProvider
+        fetches header+commit+valset, the Inquiring certifier verifies."""
+        from tendermint_tpu.certifiers import InquiringCertifier
+        from tendermint_tpu.certifiers.node_provider import NodeProvider
+        from tendermint_tpu.certifiers.provider import MemProvider
+
+        solo_node.wait_height(3)
+        c = LocalClient(solo_node)
+        prov = NodeProvider(c)
+        latest = prov.latest_commit()
+        assert latest is not None and latest.height() >= 1
+        # the RPC round-trip must preserve hash integrity
+        assert latest.header.validators_hash == latest.validators.hash()
+        seed = prov.get_by_height(1)
+        assert seed is not None and seed.height() >= 1
+        cert = InquiringCertifier("client-test", seed, MemProvider(), prov)
+        cert.certify(latest)
+
+    def test_unsafe_flush_mempool_and_dial_seeds_routes(self, solo_node):
+        from tendermint_tpu.rpc.core import make_routes
+
+        solo_node.config.rpc.unsafe = True
+        routes = make_routes(solo_node)
+        assert routes["unsafe_flush_mempool"]() == {"result": "flushed"}
+        with pytest.raises(Exception):
+            routes["dial_seeds"](seeds="")
+        # dialing an unreachable seed must not raise (background thread)
+        routes["dial_seeds"](seeds="127.0.0.1:1")
+
 
 class TestWALTools:
     def test_wal2json_and_cut(self, tmp_path, capsys, solo_node):
