@@ -92,7 +92,26 @@ def _bench_verify_tables(n_vals: int, stack: int = 64, warm_reps: int = 4) -> di
     kr = np.tile(r, (stack, 1))
     stack_s, stack_compile_s = _warm_time(ks, kh, kr, warm_reps)
 
+    # valset-diff rebuild: swap ONE validator and rebuild through the
+    # service's incremental path (host-build the 1 new key + device
+    # gather of the unchanged columns) — vs table_build_s from scratch
+    from tendermint_tpu.crypto.keys import gen_priv_key as _gen
+    from tendermint_tpu.services import TableBatchVerifier
+
+    svc = TableBatchVerifier()
+    svc._tables[svc._cache_key(tuple(pubs))] = (tuple(pubs), tables, key_ok)
+    rebuild_s = None
+    for seed in (b"\xaa", b"\xbb"):  # 2nd run = warm (gather jit cached)
+        pubs2 = list(pubs)
+        pubs2[n_vals // 2] = _gen(seed * 32).pub_key.data
+        t0 = time.time()
+        t2, ok2 = svc._tables_for(tuple(pubs2))
+        np.asarray(t2[0, 0, 0, :4])  # d2h fetch = the axon sync point
+        np.asarray(ok2)
+        rebuild_s = time.time() - t0
+
     return {
+        "rebuild_1key_s": round(rebuild_s, 2),
         "n": n_vals,
         "stack": stack,
         "table_build_s": round(build_s, 2),
@@ -185,6 +204,11 @@ def main() -> None:
     sys.stderr.write(f"tables@1k x64: {t1k}\n")
     v1k = _bench_verify(1_000)
     sys.stderr.write(f"generic@1k: {v1k}\n")
+    # ad-hoc batches large enough to clear the ~60 ms dispatch floor
+    # (the service accumulates ad-hoc triples, so big flushes are the
+    # realistic heavy-load shape; docs/PLATFORM_NOTES.md has the floor)
+    v8k = _bench_verify(8_000)
+    sys.stderr.write(f"generic@8k: {v8k}\n")
     m = _bench_merkle(65_536)
     sys.stderr.write(f"merkle@65k: {m}\n")
 
@@ -203,8 +227,10 @@ def main() -> None:
             ),
             "commit_1k_validators_ms": t1k["commit_ms"],
             "table_build_10k_s": t10k["table_build_s"],
+            "table_rebuild_1key_s": t10k["rebuild_1key_s"],
             "host_prep_10k_s": t10k["host_prep_s"],
             "generic_ladder_verifies_per_s": round(v1k["verifies_per_s"], 1),
+            "generic_ladder_8k_verifies_per_s": round(v8k["verifies_per_s"], 1),
             "merkle_leaves_per_s": round(m["leaves_per_s"], 1),
             "merkle_65k_ms": round(m["warm_s"] * 1e3, 2),
         },

@@ -111,44 +111,52 @@ class ABCISocketServer:
                 pass
 
     def _handle(self, req: bytes) -> bytes:
-        r = Reader(req)
-        tag = r.uvarint()
-        w = Writer()
-        with self._lock:
-            if tag == _MSG_ECHO:
-                w.string(self.app.echo(r.string()))
-            elif tag == _MSG_INFO:
-                info = self.app.info()
-                w.string(info.data).string(info.version)
-                w.uvarint(info.last_block_height).bytes(info.last_block_app_hash)
-            elif tag == _MSG_FLUSH:
-                pass
-            elif tag == _MSG_CHECK_TX:
-                w.raw(self.app.check_tx(r.bytes()).encode())
-            elif tag == _MSG_DELIVER_TX:
-                w.raw(self.app.deliver_tx(r.bytes()).encode())
-            elif tag == _MSG_BEGIN_BLOCK:
-                from tendermint_tpu.types.block import Header
+        return handle_abci_request(self.app, self._lock, req)
 
-                block_hash = r.bytes()
-                header = Header.decode_from(Reader(r.bytes()))
-                self.app.begin_block(block_hash, header)
-            elif tag == _MSG_END_BLOCK:
-                _enc_validators(w, self.app.end_block(r.uvarint()))
-            elif tag == _MSG_COMMIT:
-                w.raw(self.app.commit().encode())
-            elif tag == _MSG_QUERY:
-                res = self.app.query(
-                    r.string(), r.bytes(), r.uvarint(), r.bool()
-                )
-                w.uvarint(res.code).svarint(res.index).bytes(res.key)
-                w.bytes(res.value).bytes(res.proof).uvarint(res.height)
-                w.string(res.log)
-            elif tag == _MSG_INIT_CHAIN:
-                self.app.init_chain(_dec_validators(r))
-            else:
-                raise ConnectionError(f"unknown abci message {tag:#x}")
-        return w.build()
+
+def handle_abci_request(app: Application, lock: threading.Lock, req: bytes) -> bytes:
+    """Dispatch one framed ABCI request to the app — shared by every
+    remote transport (socket here, gRPC in `abci/grpc_transport.py`);
+    the reference likewise serves one request codec over both
+    (`proxy/client.go:14-80`)."""
+    r = Reader(req)
+    tag = r.uvarint()
+    w = Writer()
+    with lock:
+        if tag == _MSG_ECHO:
+            w.string(app.echo(r.string()))
+        elif tag == _MSG_INFO:
+            info = app.info()
+            w.string(info.data).string(info.version)
+            w.uvarint(info.last_block_height).bytes(info.last_block_app_hash)
+        elif tag == _MSG_FLUSH:
+            pass
+        elif tag == _MSG_CHECK_TX:
+            w.raw(app.check_tx(r.bytes()).encode())
+        elif tag == _MSG_DELIVER_TX:
+            w.raw(app.deliver_tx(r.bytes()).encode())
+        elif tag == _MSG_BEGIN_BLOCK:
+            from tendermint_tpu.types.block import Header
+
+            block_hash = r.bytes()
+            header = Header.decode_from(Reader(r.bytes()))
+            app.begin_block(block_hash, header)
+        elif tag == _MSG_END_BLOCK:
+            _enc_validators(w, app.end_block(r.uvarint()))
+        elif tag == _MSG_COMMIT:
+            w.raw(app.commit().encode())
+        elif tag == _MSG_QUERY:
+            res = app.query(
+                r.string(), r.bytes(), r.uvarint(), r.bool()
+            )
+            w.uvarint(res.code).svarint(res.index).bytes(res.key)
+            w.bytes(res.value).bytes(res.proof).uvarint(res.height)
+            w.string(res.log)
+        elif tag == _MSG_INIT_CHAIN:
+            app.init_chain(_dec_validators(r))
+        else:
+            raise ConnectionError(f"unknown abci message {tag:#x}")
+    return w.build()
 
 
 # -- client (node side) -------------------------------------------------------

@@ -164,12 +164,20 @@ class AddrBook:
         with self._lock:
             return node_id in self._entries
 
-    def pick_address(self, bias_old: float = 0.5) -> NetAddress | None:
+    def pick_address(
+        self, bias_old: float = 0.5, exclude: set[str] | None = None
+    ) -> NetAddress | None:
         """Random address to dial, biased between old (proven) and new
-        entries (reference `PickAddress`)."""
+        entries (reference `PickAddress`); `exclude` filters node ids
+        already connected or already attempted this pass."""
         with self._lock:
-            old = [e for e in self._entries.values() if e.is_old]
-            new = [e for e in self._entries.values() if not e.is_old]
+            entries = [
+                e
+                for e in self._entries.values()
+                if not exclude or e.address.node_id not in exclude
+            ]
+            old = [e for e in entries if e.is_old]
+            new = [e for e in entries if not e.is_old]
             pool = old if (old and self._rng.random() < bias_old) else new
             pool = pool or old
             if not pool:

@@ -9,7 +9,6 @@ target peer count.
 from __future__ import annotations
 
 import threading
-import time
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.addrbook import AddrBook, NetAddress
@@ -64,6 +63,11 @@ class PEXReactor(Reactor):
         self._dial_fn = dial_fn
         self._running = False
         self._requested: set[str] = set()
+        # wakes the ensure loop the moment new addresses arrive, so
+        # discovery latency is bounded by gossip, not the poll interval
+        # (also what makes multi-node PEX tests deterministic instead of
+        # racing wall-clock ticks)
+        self._wake = threading.Event()
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(PEX_CHANNEL, priority=1)]
@@ -76,6 +80,7 @@ class PEXReactor(Reactor):
 
     def on_stop(self) -> None:
         self._running = False
+        self._wake.set()  # unblock the ensure loop so it exits promptly
         self.book.flush()
 
     def add_peer(self, peer: Peer) -> None:
@@ -105,9 +110,13 @@ class PEXReactor(Reactor):
             )
         elif kind == "addrs":
             me = self.switch.node_info.node_id if self.switch else ""
+            added = False
             for addr in arg:
                 if addr.node_id != me:
                     self.book.add_address(addr, src_id=peer.id)
+                    added = True
+            if added:
+                self._wake.set()  # try the fresh addresses immediately
 
     # -- ensure-peers loop -------------------------------------------------
 
@@ -120,28 +129,51 @@ class PEXReactor(Reactor):
 
     def _ensure_peers_routine(self) -> None:
         """Reference `ensurePeersRoutine`: top up outbound connections
-        from the book while below target."""
+        from the book while below target. Event-driven: fresh gossip
+        wakes the loop instead of waiting out the poll interval."""
         while self._running:
-            time.sleep(self.ensure_interval_s)
-            if self.switch is None:
-                continue
-            have = {p.id for p in self.switch.peers()}
-            if len(have) >= self.max_peers:
-                continue
-            addr = self.book.pick_address()
-            if addr is None or addr.node_id in have:
-                continue
+            self._wake.wait(timeout=self.ensure_interval_s)
+            self._wake.clear()
+            if not self._running:
+                return
+            self.ensure_peers()
+
+    # dial attempts per top-up pass: bounds how long one pass can block
+    # on unreachable addresses (each TCP connect can take its full
+    # timeout) so stop() and fresh-gossip wakeups aren't starved
+    MAX_DIALS_PER_PASS = 10
+
+    def ensure_peers(self) -> None:
+        """One top-up pass: dial distinct book addresses until the peer
+        target is met, candidates run out, or the per-pass dial budget
+        is spent (the reference dials the whole deficit per tick,
+        `pex_reactor.go` ensurePeers)."""
+        if self.switch is None:
+            return
+        have = {p.id for p in self.switch.peers()}
+        tried: set[str] = set()
+        while self._running and len(have) < self.max_peers:
+            if len(tried) >= self.MAX_DIALS_PER_PASS:
+                self._wake.set()  # finish the deficit next pass
+                return
+            addr = self.book.pick_address(exclude=have | tried)
+            if addr is None:
+                return
+            tried.add(addr.node_id)
             self.book.mark_attempt(addr.node_id)
             try:
                 peer = self._dial(addr)
             except Exception:
                 continue  # attempts counter already bumped; book evicts flakes
+            if peer is None:
+                continue  # dial_fn signalled failure: stays unproven
             # promote ONLY if the authenticated identity matches the book
             # entry — otherwise gossip pointed this node_id at someone
             # else's address (eclipse attempt): purge it
-            if peer is not None and peer.id != addr.node_id:
+            if peer.id != addr.node_id:
                 self.book.remove(addr.node_id)
                 if self.switch is not None:
                     self.switch.stop_peer_for_error(peer, "pex id mismatch")
                 continue
             self.book.mark_good(addr.node_id)
+            have.add(addr.node_id)

@@ -10,6 +10,7 @@ from tendermint_tpu.services.verifier import (
     BatchVerifier,
     DeviceBatchVerifier,
     HostBatchVerifier,
+    TableBatchVerifier,
     default_verifier,
 )
 
@@ -17,6 +18,7 @@ __all__ = [
     "BatchVerifier",
     "DeviceBatchVerifier",
     "HostBatchVerifier",
+    "TableBatchVerifier",
     "TreeHasher",
     "default_verifier",
 ]

@@ -116,31 +116,101 @@ class TableBatchVerifier(DeviceBatchVerifier):
     triples (proposal sigs, mixed-key batches).
     """
 
+    # diffs up to this many NEW keys rebuild incrementally: unchanged
+    # columns are gathered from the cached tables on device and only the
+    # new keys are built (host-side — faster than the device build
+    # kernel below ~100 keys and compile-free)
+    MAX_INCREMENTAL_KEYS = 128
+
     def __init__(self, cache_size: int = 4, min_device_batch: int | None = None) -> None:
         super().__init__(min_device_batch)
+        import threading
         from collections import OrderedDict
 
+        # key -> (pubkeys tuple, tables, ok)
         self._tables: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._cache_size = cache_size
+        self._cache_lock = threading.RLock()
 
-    def _tables_for(self, pubkeys: tuple[bytes, ...]):
+    @staticmethod
+    def _cache_key(pubkeys: tuple[bytes, ...]) -> bytes:
         import hashlib
 
-        key = hashlib.sha256(b"".join(pubkeys)).digest()
-        hit = self._tables.get(key)
-        if hit is not None:
-            self._tables.move_to_end(key)
-            return hit
-        from tendermint_tpu.ops.ed25519_tables import build_key_tables
+        return hashlib.sha256(b"".join(pubkeys)).digest()
 
-        pub = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(
-            len(pubkeys), 32
+    def _incremental_build(self, pubkeys: tuple[bytes, ...]):
+        """Assemble tables for `pubkeys` from the cached set with the
+        largest overlap: device-gather the shared columns, host-build
+        only the new keys. Returns None when no cached set shares enough
+        (EndBlock diffs touch few keys — reference
+        `state/execution.go:120-159` — so turnover is usually tiny)."""
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops.ed25519_tables import host_build_key_tables
+
+        with self._cache_lock:
+            best = None
+            for _k, (old_pubs, old_t, old_ok) in self._tables.items():
+                pos = {pk: i for i, pk in enumerate(old_pubs)}
+                hits = sum(1 for pk in pubkeys if pk in pos)
+                if best is None or hits > best[0]:
+                    best = (hits, pos, old_t, old_ok)
+        if best is None:
+            return None
+        hits, pos, old_t, old_ok = best
+        missing = [pk for pk in pubkeys if pk not in pos]
+        if len(missing) > self.MAX_INCREMENTAL_KEYS:
+            return None
+        if missing:
+            new_t, new_ok = host_build_key_tables(missing)
+            combined = jnp.concatenate([old_t, jnp.asarray(new_t)], axis=3)
+            ok_comb = np.concatenate([old_ok, new_ok])
+        else:  # same keys, different order/subset: pure gather
+            combined, ok_comb = old_t, old_ok
+        new_pos = {pk: i for i, pk in enumerate(missing)}
+        n_old = len(pos)
+        perm = np.array(
+            [pos.get(pk, n_old + new_pos.get(pk, 0)) for pk in pubkeys],
+            dtype=np.int32,
         )
-        tables, ok = build_key_tables(pub)
-        self._tables[key] = (tables, ok)
-        while len(self._tables) > self._cache_size:
-            self._tables.popitem(last=False)
+        tables = jnp.take(combined, jnp.asarray(perm), axis=3)
+        return tables, ok_comb[perm]
+
+    def _tables_for(self, pubkeys: tuple[bytes, ...]):
+        key = self._cache_key(pubkeys)
+        with self._cache_lock:
+            hit = self._tables.get(key)
+            if hit is not None:
+                self._tables.move_to_end(key)
+                return hit[1], hit[2]
+        built = self._incremental_build(pubkeys)
+        if built is None:
+            from tendermint_tpu.ops.ed25519_tables import build_key_tables
+
+            pub = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(
+                len(pubkeys), 32
+            )
+            built = build_key_tables(pub)
+        tables, ok = built
+        with self._cache_lock:
+            self._tables[key] = (tuple(pubkeys), tables, ok)
+            while len(self._tables) > self._cache_size:
+                self._tables.popitem(last=False)
         return tables, ok
+
+    def prebuild(self, pubkeys) -> None:
+        """Warm the table cache for a validator set in the background —
+        called when a valset rotation is decided (EndBlock diffs) so the
+        first verify against the NEXT set doesn't stall on a build."""
+        import threading
+
+        pubs = tuple(bytes(pk) for pk in pubkeys)
+        if self._cache_key(pubs) in self._tables:
+            return
+
+        threading.Thread(
+            target=lambda: self._tables_for(pubs), daemon=True
+        ).start()
 
     def verify_commits(
         self,

@@ -120,6 +120,11 @@ def apply_block(
     if tx_indexer is not None:
         tx_indexer.add_batch(block, abci_responses)
     state.set_block_and_validators(block.header, part_set_header, abci_responses)
+    if abci_responses.end_block_changes and hasattr(verifier, "prebuild"):
+        # valset rotation decided: warm the NEXT set's verify tables in
+        # the background so the first commit signed by the new set
+        # doesn't stall on a table build (SURVEY §7 hard part 4)
+        verifier.prebuild([v.pub_key.data for v in state.validators])
 
     # app Commit under the mempool lock, then recheck leftover txs
     # (reference CommitStateUpdateMempool `state/execution.go:254-277`)

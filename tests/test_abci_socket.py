@@ -1,9 +1,12 @@
-"""Remote ABCI over sockets: the process boundary (reference
-`proxy/client.go` remote creators + `test/app/*_test.sh`)."""
+"""Remote ABCI over sockets AND gRPC: the process boundary (reference
+`proxy/client.go:14-80` remote creators + `test/app/*_test.sh`). Both
+transports run the same suite — the reference ships socket and grpc
+arms of NewRemoteClientCreator."""
 
 import pytest
 
 from tendermint_tpu.abci.apps import KVStoreApp
+from tendermint_tpu.abci.grpc_transport import ABCIGrpcServer, grpc_client_creator
 from tendermint_tpu.abci.socket import ABCISocketServer, socket_client_creator
 from tendermint_tpu.abci.types import Validator as ABCIValidator
 from tendermint_tpu.cmd import main as cli_main
@@ -14,18 +17,23 @@ from tendermint_tpu.rpc.client import LocalClient
 pytestmark = pytest.mark.slow
 
 
-@pytest.fixture()
-def served_app():
+@pytest.fixture(params=["socket", "grpc"])
+def served_app(request):
     app = KVStoreApp()
-    srv = ABCISocketServer(app, "tcp://127.0.0.1:0")
-    yield app, f"127.0.0.1:{srv.port}"
+    if request.param == "socket":
+        srv = ABCISocketServer(app, "tcp://127.0.0.1:0")
+        creator = socket_client_creator
+    else:
+        srv = ABCIGrpcServer(app, "tcp://127.0.0.1:0")
+        creator = grpc_client_creator
+    yield app, creator(f"127.0.0.1:{srv.port}")
     srv.stop()
 
 
 class TestSocketProxy:
     def test_three_connections_round_trip(self, served_app):
-        app, addr = served_app
-        conns = socket_client_creator(addr)()
+        app, creator = served_app
+        conns = creator()
         assert conns.query.echo_sync("ping") == "ping"
         info = conns.query.info_sync()
         assert info.last_block_height == 0
@@ -53,12 +61,12 @@ class TestSocketProxy:
         assert q.value == b"v"
 
     def test_node_runs_against_remote_app(self, served_app, tmp_path):
-        _, addr = served_app
+        _, creator = served_app
         home = str(tmp_path / "remote-app-node")
         cli_main(["init", "--home", home, "--chain-id", "remote-abci"])
         cfg = Config.test_config(home)
         cfg.base.fast_sync = False
-        node = Node(cfg, client_creator=socket_client_creator(addr))
+        node = Node(cfg, client_creator=creator)
         node.start()
         try:
             c = LocalClient(node)

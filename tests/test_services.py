@@ -84,6 +84,84 @@ class TestTreeHasher:
         assert th.root_from_items([b"one"]) == simple_hash_from_byte_slices([b"one"])
 
 
+class TestIncrementalTableBuild:
+    def test_valset_diff_rebuilds_only_changed_columns(self, monkeypatch):
+        """Swapping 1 validator of 8 must build tables for exactly the
+        1 new key (unchanged columns gathered from the cached set) and
+        verify correctly right away (VERDICT r3 #3; EndBlock diffs touch
+        few keys, reference state/execution.go:120-159)."""
+        import tendermint_tpu.services.verifier as svc
+        from tendermint_tpu.ops import ed25519_tables as tb
+        from tendermint_tpu.services import TableBatchVerifier
+
+        built_counts: list[int] = []
+        _orig_host = tb.host_build_key_tables
+
+        def counting_host_build(pubs):
+            built_counts.append(len(pubs))
+            return _orig_host([bytes(pk) for pk in pubs])
+
+        # full builds route through the (device) build_key_tables; back
+        # both builders with the host builder to keep the test
+        # device-free while counting how many keys get built
+        monkeypatch.setattr(
+            tb, "build_key_tables", lambda arr: counting_host_build(list(arr))
+        )
+        monkeypatch.setattr(tb, "host_build_key_tables", counting_host_build)
+        assert svc is not None  # imported for monkeypatch targets
+
+        n = 8
+        privs = [gen_priv_key(bytes([i + 1]) * 32) for i in range(n)]
+        pubs = [p.pub_key.data for p in privs]
+        v = TableBatchVerifier(min_device_batch=1)
+
+        def commit_for(privs_, pubs_):
+            msgs = [b"vote-%d" % i for i in range(len(privs_))]
+            sigs = [p.sign(m) for p, m in zip(privs_, msgs)]
+            return v.verify_commits(pubs_, [(msgs, sigs)])
+
+        out = commit_for(privs, pubs)
+        assert out.all()
+        assert built_counts == [n]  # full build of all 8
+
+        # rotate validator 3 out, a brand-new key in
+        new_priv = gen_priv_key(b"\x99" * 32)
+        privs2 = list(privs)
+        privs2[3] = new_priv
+        pubs2 = [p.pub_key.data for p in privs2]
+        out2 = commit_for(privs2, pubs2)
+        assert out2.all()
+        assert built_counts == [n, 1]  # incremental: only the new key
+
+        # the incremental tables are bit-identical to a from-scratch build
+        inc_tables, inc_ok = v._tables_for(tuple(pubs2))
+        full_tables, full_ok = _orig_host(pubs2)
+        np.testing.assert_array_equal(np.asarray(inc_tables), full_tables)
+        assert inc_ok.tolist() == full_ok.tolist()
+
+    def test_prebuild_warms_cache_async(self, monkeypatch):
+        from tendermint_tpu.ops import ed25519_tables as tb
+        from tendermint_tpu.services import TableBatchVerifier
+
+        _orig_host = tb.host_build_key_tables
+        monkeypatch.setattr(
+            tb,
+            "build_key_tables",
+            lambda arr: _orig_host([bytes(pk) for pk in arr]),
+        )
+        privs = [gen_priv_key(bytes([i + 1]) * 32) for i in range(4)]
+        pubs = [p.pub_key.data for p in privs]
+        v = TableBatchVerifier(min_device_batch=1)
+        v.prebuild(pubs)
+        import time
+
+        deadline = time.time() + 30
+        key = v._cache_key(tuple(pubs))
+        while time.time() < deadline and key not in v._tables:
+            time.sleep(0.05)
+        assert key in v._tables
+
+
 class TestShardedVerify:
     def test_verify_and_tally_on_8_device_mesh(self):
         import jax
@@ -106,6 +184,40 @@ class TestShardedVerify:
         ok = np.asarray(ok)[:valid]
         assert ok.tolist() == [True] * 3 + [False] + [True] * 6
         assert int(total) == 45  # 9 valid * power 5
+
+    def test_distributed_seam_single_process(self):
+        """The multi-host seam (parallel/distributed.py) must compose
+        with the sharded verify step degenerately on one process: same
+        initialize/global-mesh/host_local_to_global calls a multi-host
+        deployment makes (SURVEY §5.8)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from tendermint_tpu.ops.ed25519_kernel import prepare_batch
+        from tendermint_tpu.parallel import distributed as dist
+        from tendermint_tpu.parallel.mesh import (
+            BATCH_AXIS,
+            pad_to_multiple,
+            sharded_verify_and_tally,
+        )
+
+        dist.initialize()  # single-process no-op
+        assert dist.process_info() == (0, 1)
+        mesh = dist.global_batch_mesh()
+        assert mesh.devices.size == 8
+
+        triples = _triples(8, corrupt={2})
+        pubs, msgs, sigs = (list(x) for x in zip(*triples))
+        pub, r, s, h, _pre = prepare_batch(pubs, msgs, sigs)
+        powers = np.full(8, 2, dtype=np.int32)
+        arrs, powers, valid = pad_to_multiple([pub, r, s, h], powers, 8)
+        spec = P(BATCH_AXIS)
+        placed = [dist.host_local_to_global(mesh, spec, a) for a in arrs]
+        pw = dist.host_local_to_global(mesh, spec, powers)
+        ok, total = sharded_verify_and_tally(mesh)(*placed, pw)
+        ok = np.asarray(ok)[:valid]
+        assert ok.tolist() == [True, True, False, True, True, True, True, True]
+        assert int(total) == 2 * 7
 
     def test_tables_path_on_8_device_mesh(self):
         """The production TABLE fast path sharded along the validator
