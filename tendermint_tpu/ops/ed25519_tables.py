@@ -449,12 +449,8 @@ def _select_entries(a_tables, s, h):
     per window — the whole table streams through the VPU exactly once
     (a true gather would be ~60x slower on TPU, measured).
     """
-    n_v = a_tables.shape[3]
-    a_tables = jnp.transpose(a_tables, (0, 1, 3, 2)).reshape(
-        A_NWIN * 16, n_v, 3 * NLIMBS
-    ).astype(jnp.int32)
     bsz = s.shape[0]
-    n_vals = a_tables.shape[1]
+    n_vals = a_tables.shape[3]
     reps = bsz // n_vals
     btab = jnp.asarray(b_table()).reshape(B_NWIN, 256, 60).astype(jnp.float32)
     outs = []
@@ -478,7 +474,10 @@ def _select_entries(a_tables, s, h):
         digit = (byte >> (4 * (w % 2))) & 0xF
         acc = None
         for d in range(16):
-            twd = a_tables[w * 16 + d]  # (N, 60), major-axis slice
+            # per-slice transpose+convert of the canonical int16 layout:
+            # fuses into the consumer as strided reads — materializing a
+            # whole int32 copy of the table cost ~33 ms at N=10k
+            twd = jnp.transpose(a_tables[w, d]).astype(jnp.int32)  # (N, 60)
             if reps != 1:
                 twd = jnp.broadcast_to(twd[None], (reps, n_vals, 60)).reshape(
                     bsz, 60

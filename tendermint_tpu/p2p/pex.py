@@ -101,6 +101,7 @@ class PEXReactor(Reactor):
 
     def remove_peer(self, peer: Peer, reason) -> None:
         self._requested.discard(peer.id)
+        self._wake.set()  # top back up promptly after a peer drops
 
     def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
         kind, arg = decode_message(payload)
@@ -113,10 +114,11 @@ class PEXReactor(Reactor):
             added = False
             for addr in arg:
                 if addr.node_id != me:
-                    self.book.add_address(addr, src_id=peer.id)
-                    added = True
+                    added |= self.book.add_address(addr, src_id=peer.id)
             if added:
-                self._wake.set()  # try the fresh addresses immediately
+                # only GENUINELY new book entries wake the loop —
+                # already-known gossip must not trigger re-dial passes
+                self._wake.set()
 
     # -- ensure-peers loop -------------------------------------------------
 
@@ -127,16 +129,32 @@ class PEXReactor(Reactor):
 
         return dial(self.switch, addr.addr, priv_key=self.node_key)
 
+    # wake-driven passes may not repeat faster than this — failed dials
+    # return in milliseconds, so without a floor a book full of dead
+    # addresses would be hammered in a tight burst
+    MIN_PASS_SPACING_S = 1.0
+
     def _ensure_peers_routine(self) -> None:
         """Reference `ensurePeersRoutine`: top up outbound connections
         from the book while below target. Event-driven: fresh gossip
-        wakes the loop instead of waiting out the poll interval."""
+        wakes the loop instead of waiting out the poll interval, with a
+        minimum spacing between passes as dial-storm backoff."""
+        import time as _time
+
+        last_pass = 0.0
         while self._running:
             self._wake.wait(timeout=self.ensure_interval_s)
             self._wake.clear()
             if not self._running:
                 return
+            spacing = min(self.MIN_PASS_SPACING_S, self.ensure_interval_s)
+            since = _time.monotonic() - last_pass
+            if since < spacing:
+                _time.sleep(spacing - since)
+                if not self._running:
+                    return
             self.ensure_peers()
+            last_pass = _time.monotonic()
 
     # dial attempts per top-up pass: bounds how long one pass can block
     # on unreachable addresses (each TCP connect can take its full
@@ -154,7 +172,9 @@ class PEXReactor(Reactor):
         tried: set[str] = set()
         while self._running and len(have) < self.max_peers:
             if len(tried) >= self.MAX_DIALS_PER_PASS:
-                self._wake.set()  # finish the deficit next pass
+                # deficit continues next pass (interval tick or the next
+                # genuine wake) — self-waking here would defeat the
+                # dial-storm backoff
                 return
             addr = self.book.pick_address(exclude=have | tried)
             if addr is None:

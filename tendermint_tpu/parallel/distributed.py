@@ -34,7 +34,7 @@ from __future__ import annotations
 
 import numpy as np
 
-_initialized = False
+_init_mode: str | None = None  # None | "local" | "multi"
 
 
 def initialize(
@@ -46,12 +46,23 @@ def initialize(
 
     No-op when called with no arguments in a single-process setup (the
     common test/bench path), so call sites can run the same code on one
-    host or many. Idempotent."""
-    global _initialized
-    if _initialized:
+    host or many. Idempotent for the SAME mode; a multi-host request
+    after a local init raises instead of silently running local-only
+    (every host would otherwise verify just its own shard while
+    believing the mesh is global)."""
+    global _init_mode
+    want_multi = coordinator is not None or (
+        num_processes is not None and num_processes > 1
+    )
+    if _init_mode is not None:
+        if want_multi and _init_mode == "local":
+            raise RuntimeError(
+                "distributed.initialize: already initialized single-process; "
+                "multi-host init must happen before any local initialize()"
+            )
         return
-    if coordinator is None and num_processes in (None, 1):
-        _initialized = True  # single-process: nothing to wire
+    if not want_multi:
+        _init_mode = "local"  # single-process: nothing to wire
         return
     import jax
 
@@ -60,7 +71,7 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
-    _initialized = True
+    _init_mode = "multi"
 
 
 def global_batch_mesh():

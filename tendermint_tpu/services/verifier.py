@@ -155,7 +155,9 @@ class TableBatchVerifier(DeviceBatchVerifier):
                 hits = sum(1 for pk in pubkeys if pk in pos)
                 if best is None or hits > best[0]:
                     best = (hits, pos, old_t, old_ok)
-        if best is None:
+        if best is None or best[0] == 0:
+            # no overlap: concatenating against an unrelated cached set
+            # would copy its whole table on device for nothing
             return None
         hits, pos, old_t, old_ok = best
         missing = [pk for pk in pubkeys if pk not in pos]
