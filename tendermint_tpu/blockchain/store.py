@@ -18,7 +18,7 @@ from tendermint_tpu.db.kv import DB
 from tendermint_tpu.types.block import Block, Commit, Header
 from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.errors import ValidationError
-from tendermint_tpu.types.part_set import Part, PartSet, PartSetHeader
+from tendermint_tpu.types.part_set import Part, PartSet
 
 
 @dataclass

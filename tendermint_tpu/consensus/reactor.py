@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.consensus.round_state import RoundStepType

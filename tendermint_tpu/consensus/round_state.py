@@ -5,7 +5,7 @@ RoundState snapshot, and HeightVoteSet (prevotes+precommits per round).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 from tendermint_tpu.types.block_id import BlockID

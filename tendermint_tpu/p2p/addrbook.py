@@ -16,7 +16,7 @@ import os
 import random
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 NEW_BUCKETS = 64
 OLD_BUCKETS = 16

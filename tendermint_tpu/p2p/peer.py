@@ -8,7 +8,7 @@ Switch.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection

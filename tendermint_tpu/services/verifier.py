@@ -20,7 +20,7 @@ buckets so recompiles stay bounded).
 from __future__ import annotations
 
 import os
-from typing import Iterable, Sequence
+from typing import Sequence
 
 import numpy as np
 

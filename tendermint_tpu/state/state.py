@@ -11,7 +11,6 @@ light clients can verify old commits.
 from __future__ import annotations
 
 import json
-import time as time_mod
 from dataclasses import dataclass, field
 
 from tendermint_tpu.abci.types import Result, Validator as ABCIValidator
