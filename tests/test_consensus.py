@@ -296,6 +296,29 @@ class TestProposalHeartbeat:
         finally:
             f.cs.stop()
 
+    def test_heartbeat_ws_event_json(self):
+        """WS subscribers see heartbeats: the event payload serializes
+        to the compact JSON view (height/round/sequence/validator)."""
+        from tendermint_tpu.rpc.websocket import event_to_json
+        from tendermint_tpu.types.heartbeat import Heartbeat
+
+        hb = Heartbeat(
+            validator_address=b"\xab" * 20,
+            validator_index=1,
+            height=5,
+            round=0,
+            sequence=3,
+            signature=b"\x01" * 64,
+        )
+        out = event_to_json(ev.EVENT_PROPOSAL_HEARTBEAT, hb)
+        assert out == {
+            "event": ev.EVENT_PROPOSAL_HEARTBEAT,
+            "height": 5,
+            "round": 0,
+            "sequence": 3,
+            "validator": (b"\xab" * 20).hex(),
+        }
+
     def test_heartbeat_message_round_trip(self):
         from tendermint_tpu.consensus.reactor import (
             ProposalHeartbeatMessage,
