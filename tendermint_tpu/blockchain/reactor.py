@@ -88,6 +88,7 @@ class BlockchainReactor(Reactor):
         on_caught_up=None,
         verifier=None,
         tx_indexer=None,
+        hasher=None,
     ) -> None:
         super().__init__()
         self.state = state
@@ -97,6 +98,7 @@ class BlockchainReactor(Reactor):
         self.on_caught_up = on_caught_up
         self.verifier = verifier
         self.tx_indexer = tx_indexer
+        self.hasher = hasher
         self.pool = BlockPool(start_height=store.height + 1)
         self._running = False
         self._thread: threading.Thread | None = None
@@ -245,6 +247,7 @@ class BlockchainReactor(Reactor):
                         verifier=self.verifier,
                         tx_indexer=self.tx_indexer,
                         commit_preverified=True,
+                        hasher=self.hasher,
                     )
                 except ValidationError:
                     # commit verified but the block body is inconsistent
@@ -301,6 +304,7 @@ class BlockchainReactor(Reactor):
                 verifier=self.verifier,
                 tx_indexer=self.tx_indexer,
                 commit_preverified=True,
+                hasher=self.hasher,
             )
         except ValidationError:
             self._redo(block.header.height)

@@ -100,21 +100,27 @@ class NodeProvider(Provider):
         )
 
     def get_by_height(self, height: int) -> FullCommit | None:
+        from tendermint_tpu.rpc.client import RPCClientError
+
         try:
             return self._fetch(height)
-        except Exception:
-            # no commit stored at that exact height — fall back to the
-            # newest one not above it (the provider contract)
+        except RPCClientError:
+            # node answered "no commit at that exact height" — fall back to
+            # the newest one not above it (the provider contract). Transport
+            # and parse failures propagate: a flaky node must not be
+            # indistinguishable from a missing height.
             latest = self.latest_commit()
             if latest is not None and latest.height() <= height:
                 return latest
             return None
 
     def latest_commit(self) -> FullCommit | None:
+        from tendermint_tpu.rpc.client import RPCClientError
+
         try:
             h = int(self._client.status()["sync_info"]["latest_block_height"])
-            if h < 1:
-                return None
-            return self._fetch(h)
-        except Exception:
+        except RPCClientError:
             return None
+        if h < 1:
+            return None
+        return self._fetch(h)

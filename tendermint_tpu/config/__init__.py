@@ -56,6 +56,14 @@ class P2PConfig:
     pex_ensure_interval_s: float = 30.0  # reference ensurePeersPeriod
     send_rate: int = 512000  # bytes/s (flow limits live in MConnection)
     recv_rate: int = 512000
+    # keepalive: ping idle peers, drop them when silent past the grace
+    # window (reference pingTimeout 40s, `p2p/connection.go:312-345`)
+    ping_interval_s: float = 40.0
+    pong_timeout_s: float = 20.0
+    # persistent-peer redial policy (reference `p2p/switch.go:15-18`:
+    # reconnectAttempts 30 with backoff)
+    reconnect_max_attempts: int = 30
+    reconnect_base_backoff_s: float = 1.0
 
 
 @dataclass

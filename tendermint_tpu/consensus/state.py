@@ -79,6 +79,7 @@ class ConsensusState:
         ticker=None,
         verifier=None,
         tx_indexer=None,
+        hasher=None,
     ) -> None:
         self.config = config
         self.app_conn = app_conn
@@ -88,6 +89,9 @@ class ConsensusState:
         self.event_switch = event_switch if event_switch is not None else ev.EventSwitch()
         self.verifier = verifier
         self.tx_indexer = tx_indexer
+        # TreeHasher for proposal-block data_hash/part-set builds; None = host
+        # merkle (reference SimpleHash call sites `types/block.go:177`).
+        self.hasher = hasher
         self.wal = WAL(wal_path, light=config.wal_light) if wal_path else None
 
         self._queue: "queue.Queue" = queue.Queue()
@@ -542,9 +546,11 @@ class ConsensusState:
             time=time_mod.time_ns(),
             validators_hash=self.state.validators.hash(),
             app_hash=self.state.app_hash,
+            hasher=self.hasher,
         )
         return block, block.make_part_set(
-            self.state.consensus_params.block_gossip.block_part_size_bytes
+            self.state.consensus_params.block_gossip.block_part_size_bytes,
+            hasher=self.hasher,
         )
 
     def _default_set_proposal(self, proposal: Proposal) -> None:
@@ -623,7 +629,12 @@ class ConsensusState:
         try:
             from tendermint_tpu.state import validate_block
 
-            validate_block(self.state, self.proposal_block, verifier=self.verifier)
+            validate_block(
+                self.state,
+                self.proposal_block,
+                verifier=self.verifier,
+                hasher=self.hasher,
+            )
         except ValidationError:
             self._sign_add_vote(VOTE_TYPE_PREVOTE, b"", PartSetHeader.zero())
             return
@@ -687,7 +698,12 @@ class ConsensusState:
             from tendermint_tpu.state import validate_block
 
             try:
-                validate_block(self.state, self.proposal_block, verifier=self.verifier)
+                validate_block(
+                    self.state,
+                    self.proposal_block,
+                    verifier=self.verifier,
+                    hasher=self.hasher,
+                )
             except ValidationError as e:
                 raise ValidationError(f"+2/3 prevoted an invalid block: {e}") from e
             self.locked_round = round_
@@ -793,6 +809,7 @@ class ConsensusState:
                 verifier=self.verifier,
                 tx_indexer=self.tx_indexer,
                 on_tx_result=lambda i, tx, res: tx_results.append((tx, res)),
+                hasher=self.hasher,
             )
 
             fail_point()  # applied, before round-state reset

@@ -9,6 +9,7 @@ genesis -> state -> ABCI conns + handshake -> mempool -> reactors
 from __future__ import annotations
 
 import os
+import threading
 
 from tendermint_tpu.abci.client import local_client_creator
 from tendermint_tpu.blockchain.reactor import BlockchainReactor
@@ -48,6 +49,7 @@ class Node:
         db_provider=None,
         verifier=None,
         node_key=None,
+        hasher=None,
     ) -> None:
         self.config = config
         cfg = config
@@ -131,6 +133,15 @@ class Node:
         )
         fast_sync = cfg.base.fast_sync and not solo
 
+        # Device tree hasher for proposal data_hash/part sets on TPU
+        # (reference SimpleHash hot spots `types/tx.go:33-46`,
+        # `types/part_set.go:95-122`); host merkle elsewhere.
+        if hasher is None:
+            from tendermint_tpu.services.hasher import auto_hasher
+
+            hasher = auto_hasher()
+        self.hasher = hasher
+
         self.consensus = ConsensusState(
             config=cfg.consensus,
             state=self.state,
@@ -143,6 +154,7 @@ class Node:
             ticker=TimeoutTicker(),
             verifier=verifier,
             tx_indexer=self.tx_indexer,
+            hasher=hasher,
         )
         self.consensus_reactor = ConsensusReactor(self.consensus, fast_sync=fast_sync)
         self.blockchain_reactor = BlockchainReactor(
@@ -153,6 +165,7 @@ class Node:
             on_caught_up=self._on_caught_up,
             verifier=verifier,
             tx_indexer=self.tx_indexer,
+            hasher=hasher,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast
@@ -167,6 +180,8 @@ class Node:
         )
         self.switch.send_rate = cfg.p2p.send_rate
         self.switch.recv_rate = cfg.p2p.recv_rate
+        self.switch.ping_interval = cfg.p2p.ping_interval_s
+        self.switch.pong_timeout = cfg.p2p.pong_timeout_s
         if cfg.p2p.filter_peers:
             # ABCI-driven peer admission (reference node/node.go:259-281):
             # the app vets each peer via Query before registration. The
@@ -207,6 +222,21 @@ class Node:
         self.listener: TcpListener | None = None
         self.rpc: RPCServer | None = None
         self.grpc = None
+
+        # persistent-peer reconnection (reference `reconnectToPeer
+        # p2p/switch.go:290-320`: bounded retries with backoff). Seeds-only
+        # topologies otherwise never heal a dropped link.
+        self._persistent_addrs: set[str] = {
+            a.strip()
+            for a in cfg.p2p.persistent_peers.split(",")
+            if a.strip()
+        }
+        self._persistent_lock = threading.Lock()
+        self._persistent_dialing: set[str] = set()
+        self._peer_addr: dict[str, str] = {}  # node_id -> dialed persistent addr
+        self._p2p_running = False
+        if self._persistent_addrs:
+            self.switch.on_peer_removed = self._on_peer_removed
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -262,6 +292,9 @@ class Node:
             self.grpc.start()
         for seed in filter(None, self.config.p2p.seeds.split(",")):
             self.dial_seed(seed.strip())
+        self._p2p_running = True
+        for addr in self._persistent_addrs:
+            self._spawn_persistent_dial(addr)
 
     def dial_seed(self, addr: str) -> None:
         """Dial one seed address; failures are logged, not raised (the
@@ -274,7 +307,89 @@ class Node:
 
             logging.getLogger(__name__).warning("dial %s failed", addr)
 
+    # -- persistent peers ---------------------------------------------------
+
+    def _on_peer_removed(self, peer, reason) -> None:
+        """Heal dropped persistent links (reference `p2p/switch.go:290-320`)."""
+        addr = self._peer_addr.pop(peer.id, None)
+        if addr is None and peer.node_info.listen_addr in self._persistent_addrs:
+            # inbound persistent peer (they dialed us): still ours to heal
+            addr = peer.node_info.listen_addr
+        if addr is None or not self._p2p_running:
+            return
+        self._spawn_persistent_dial(addr)
+
+    def _adopt_inbound_persistent(self, addr: str) -> None:
+        """Map an already-connected peer to its persistent address so a
+        later drop gets redialed (they-dialed-first / race cases). Matched
+        by advertised listen_addr or socket host; a hostname that resolves
+        differently from the peer's reported address stays unmatched — the
+        listen_addr fallback in _on_peer_removed is the remaining net."""
+        host = addr.split("://")[-1].rsplit(":", 1)[0]
+        for p in self.switch.peers():
+            if p.id in self._peer_addr:
+                continue
+            sock_host = p.remote_addr.rsplit(":", 1)[0] if p.remote_addr else ""
+            if p.node_info.listen_addr == addr or (sock_host and sock_host == host):
+                self._peer_addr[p.id] = addr
+                return
+
+    def _spawn_persistent_dial(self, addr: str) -> None:
+        with self._persistent_lock:
+            if addr in self._persistent_dialing:
+                return  # a redial loop for this address is already running
+            self._persistent_dialing.add(addr)
+        threading.Thread(
+            target=self._persistent_dial_loop,
+            args=(addr,),
+            name=f"persistent-dial-{addr}",
+            daemon=True,
+        ).start()
+
+    def _persistent_dial_loop(self, addr: str) -> None:
+        import logging
+        import time
+
+        from tendermint_tpu.utils.backoff import backoff_delay
+
+        cfg = self.config.p2p
+        log = logging.getLogger(__name__)
+        try:
+            for attempt in range(max(1, cfg.reconnect_max_attempts)):
+                if not self._p2p_running:
+                    return
+                try:
+                    peer = dial(self.switch, addr, priv_key=self._node_key)
+                    self._peer_addr[peer.id] = addr
+                    # the peer may have died between registration and the
+                    # mapping write above — then _on_peer_removed already
+                    # ran, found no mapping, and nobody would redial
+                    if self.switch._peers.get(peer.id) is not peer:
+                        self._peer_addr.pop(peer.id, None)
+                        raise ConnectionError("peer dropped during dial")
+                    return
+                except Exception as e:
+                    if "duplicate peer" in str(e):
+                        # already connected (e.g. they dialed us first):
+                        # adopt the live peer so a later drop still heals
+                        self._adopt_inbound_persistent(addr)
+                        return
+                    # capped exponential backoff with jitter (reference
+                    # reconnect backoff p2p/switch.go:290-320)
+                    time.sleep(
+                        backoff_delay(attempt, cfg.reconnect_base_backoff_s)
+                    )
+            log.warning(
+                "giving up on persistent peer %s after %d attempts",
+                addr,
+                cfg.reconnect_max_attempts,
+            )
+        finally:
+            with self._persistent_lock:
+                self._persistent_dialing.discard(addr)
+
     def stop(self) -> None:
+        self._p2p_running = False  # stop persistent-peer redial loops
         if self.grpc is not None:
             self.grpc.stop()
         if self.rpc is not None:

@@ -15,11 +15,22 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
 from tendermint_tpu.utils.flowrate import Monitor
+
+# Internal keepalive channel (reference sends dedicated packetTypePing/
+# packetTypePong frames, `p2p/connection.go:312-345`; here they ride a
+# reserved channel id no reactor may claim).
+CTRL_CHANNEL = 0xFF
+_PING = b"\x01"
+_PONG = b"\x02"
+# Reference pingTimeout is 40s; pong grace on top before declaring dead.
+DEFAULT_PING_INTERVAL = 40.0
+DEFAULT_PONG_TIMEOUT = 20.0
 
 
 @dataclass(frozen=True)
@@ -56,31 +67,49 @@ class MConnection:
         on_error=None,
         send_limit: int = 0,
         recv_limit: int = 0,
+        ping_interval: float = DEFAULT_PING_INTERVAL,
+        pong_timeout: float = DEFAULT_PONG_TIMEOUT,
     ) -> None:
         # per-connection throughput stats + optional rate caps
         # (reference flowrate.Monitor at p2p/connection.go:72-73)
         self.send_monitor = Monitor(send_limit)
         self.recv_monitor = Monitor(recv_limit)
         self._endpoint = endpoint
+        if any(d.id == CTRL_CHANNEL for d in channels):
+            raise ValueError(f"channel id {CTRL_CHANNEL:#x} is reserved for keepalive")
         self._channels: dict[int, _Channel] = {
             d.id: _Channel(d) for d in channels
         }
+        # keepalive frames outrank data so a saturated link still pongs
+        self._ctrl = _Channel(
+            ChannelDescriptor(id=CTRL_CHANNEL, priority=100, send_queue_capacity=4)
+        )
+        self._channels[CTRL_CHANNEL] = self._ctrl
         self._on_receive = on_receive
         self._on_error = on_error
         self._send_wake = threading.Event()
         self._running = False
         self._threads: list[threading.Thread] = []
         self._err_once = threading.Event()
+        self.ping_interval = ping_interval
+        self.pong_timeout = pong_timeout
+        self._last_recv = time.monotonic()
+        self._ping_stop = threading.Event()
 
     def start(self) -> None:
         self._running = True
-        for fn, name in ((self._send_loop, "send"), (self._recv_loop, "recv")):
+        self._last_recv = time.monotonic()
+        loops = [(self._send_loop, "send"), (self._recv_loop, "recv")]
+        if self.ping_interval > 0:
+            loops.append((self._ping_loop, "ping"))
+        for fn, name in loops:
             t = threading.Thread(target=fn, name=f"mconn-{name}", daemon=True)
             t.start()
             self._threads.append(t)
 
     def stop(self) -> None:
         self._running = False
+        self._ping_stop.set()
         self._endpoint.close()
         self._send_wake.set()
 
@@ -169,6 +198,18 @@ class MConnection:
                 r = Reader(frame)
                 chan_id = r.uvarint()
                 payload = r.bytes()
+                self._last_recv = time.monotonic()
+                if chan_id == CTRL_CHANNEL:
+                    # keepalive (reference recvRoutine ping/pong handling
+                    # `p2p/connection.go:412-425`): answer pings; any pong
+                    # already refreshed _last_recv above
+                    if payload == _PING:
+                        try:
+                            self._ctrl.queue.put_nowait(_PONG)
+                            self._send_wake.set()
+                        except queue.Full:
+                            pass  # a pong is already queued
+                    continue
                 if chan_id not in self._channels:
                     continue  # unknown channel: drop (fuzz/future-proof)
                 self._on_receive(chan_id, payload)
@@ -177,11 +218,37 @@ class MConnection:
         except Exception as e:
             self._die(e)
 
+    # -- keepalive ---------------------------------------------------------
+
+    def _ping_loop(self) -> None:
+        """Ping idle peers; kill the conn when nothing (not even a pong)
+        arrives for ping_interval + pong_timeout (reference pingTimeout,
+        `p2p/connection.go:312-345`). Without this an idle-but-dead peer
+        holds its slot until some send fails."""
+        tick = min(1.0, self.ping_interval / 4)
+        last_ping = 0.0
+        while self._running and not self._ping_stop.wait(timeout=tick):
+            now = time.monotonic()
+            idle = now - self._last_recv
+            if idle > self.ping_interval + self.pong_timeout:
+                self._die(TimeoutError(f"peer silent for {idle:.1f}s (ping timeout)"))
+                return
+            # one ping per interval, not per tick — re-ping only after the
+            # previous one has gone unanswered a full interval
+            if idle > self.ping_interval and now - last_ping > self.ping_interval:
+                last_ping = now
+                try:
+                    self._ctrl.queue.put_nowait(_PING)
+                    self._send_wake.set()
+                except queue.Full:
+                    pass  # a ping is already in flight
+
     def _die(self, exc: Exception | None) -> None:
         if self._err_once.is_set():
             return
         self._err_once.set()
         self._running = False
+        self._ping_stop.set()
         self._endpoint.close()
         if self._on_error is not None:
             self._on_error(exc)

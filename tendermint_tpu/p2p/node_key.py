@@ -31,7 +31,10 @@ class NodeKey:
     def save(self, file_path: str) -> None:
         tmp = file_path + ".tmp"
         os.makedirs(os.path.dirname(file_path) or ".", exist_ok=True)
-        with open(tmp, "w") as f:
+        # key material: owner-only from creation (reference WriteFileAtomic
+        # 0600, `types/priv_validator.go`), never umask-dependent
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump({"priv_key_seed": self.priv_key.seed.hex()}, f)
         os.replace(tmp, file_path)
 

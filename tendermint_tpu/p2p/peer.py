@@ -80,10 +80,17 @@ class Peer:
         outbound: bool,
         send_limit: int = 0,
         recv_limit: int = 0,
+        ping_interval: float | None = None,
+        pong_timeout: float | None = None,
     ) -> None:
         self.node_info = node_info
         self.outbound = outbound
         self.data: dict[str, object] = {}  # reactor KV (PeerState lives here)
+        kw = {}
+        if ping_interval is not None:
+            kw["ping_interval"] = ping_interval
+        if pong_timeout is not None:
+            kw["pong_timeout"] = pong_timeout
         self._conn = MConnection(
             endpoint,
             channels,
@@ -91,6 +98,7 @@ class Peer:
             lambda exc: on_error(self, exc),
             send_limit=send_limit,
             recv_limit=recv_limit,
+            **kw,
         )
 
     @property
@@ -100,6 +108,11 @@ class Peer:
     @property
     def recv_monitor(self):
         return self._conn.recv_monitor
+
+    @property
+    def remote_addr(self) -> str:
+        """Socket-level remote address ("" for in-memory transports)."""
+        return getattr(self._conn._endpoint, "remote_addr", "")
 
     @property
     def id(self) -> str:

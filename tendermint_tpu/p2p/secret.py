@@ -52,6 +52,8 @@ class SecretEndpoint:
 
     def __init__(self, inner, priv_key: PrivKey) -> None:
         self._inner = inner
+        # socket-level identity passes through the encryption layer
+        self.remote_addr = getattr(inner, "remote_addr", "")
         self.remote_pub_key: PubKey | None = None
         self._send_nonce = 0
         self._recv_nonce = 0

@@ -60,6 +60,19 @@ class Switch:
         # per-peer flow caps, bytes/s (0 = unlimited; reference 500 kB/s)
         self.send_rate = 0
         self.recv_rate = 0
+        # keepalive cadence (reference pingTimeout `p2p/connection.go:312`);
+        # 0 disables pings (in-proc test meshes don't need them)
+        from tendermint_tpu.p2p.connection import (
+            DEFAULT_PING_INTERVAL,
+            DEFAULT_PONG_TIMEOUT,
+        )
+
+        self.ping_interval = DEFAULT_PING_INTERVAL
+        self.pong_timeout = DEFAULT_PONG_TIMEOUT
+        # fires after a peer is fully removed: fn(peer, reason). The node
+        # hangs persistent-peer reconnection off this (reference
+        # `reconnectToPeer p2p/switch.go:290-320`).
+        self.on_peer_removed = None
         # optional admission hook (ABCI peer filters, reference
         # `node/node.go:259-281`): fn(remote_info, remote_addr) -> error
         # string or None; a non-None return rejects the peer before
@@ -150,6 +163,8 @@ class Switch:
                 outbound,
                 send_limit=self.send_rate,
                 recv_limit=self.recv_rate,
+                ping_interval=self.ping_interval,
+                pong_timeout=self.pong_timeout,
             )
             self._peers[remote_info.node_id] = peer
         peer.start()
@@ -180,6 +195,8 @@ class Switch:
         )
         for r in self._reactors.values():
             r.remove_peer(peer, reason)
+        if self.on_peer_removed is not None:
+            self.on_peer_removed(peer, reason)
 
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
         """Reference `StopPeerForError` — reactors call this on bad

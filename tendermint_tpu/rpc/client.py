@@ -55,11 +55,17 @@ class _ClientBase:
     def num_unconfirmed_txs(self):
         return self._call("num_unconfirmed_txs")
 
+    def unconfirmed_txs(self):
+        return self._call("unconfirmed_txs")
+
+    def abci_info(self):
+        return self._call("abci_info")
+
     def genesis(self):
         return self._call("genesis")
 
-    def tx(self, tx_hash: bytes):
-        return self._call("tx", hash=tx_hash.hex())
+    def tx(self, tx_hash: bytes, prove: bool = False):
+        return self._call("tx", hash=tx_hash.hex(), prove=prove)
 
     def broadcast_tx_async(self, tx: bytes):
         return self._call("broadcast_tx_async", tx=tx.hex())
@@ -104,23 +110,48 @@ class WSClient:
         ws = WSClient("127.0.0.1:46657")
         ws.subscribe("NewBlock")
         for event in ws.events(timeout=10): ...
+
+    When `reconnect=True` (default), a dead connection is transparently
+    re-established with jittered exponential backoff and all active
+    subscriptions re-issued (reference auto-reconnect + resubscribe,
+    `rpc/lib/client/ws_client.go:46-59`).
     """
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        reconnect: bool = True,
+        max_reconnect_attempts: int = 25,
+        reconnect_base_backoff_s: float = 0.25,
+    ):
+        from tendermint_tpu.p2p.tcp import parse_laddr
+
+        self._host, self._port = parse_laddr(
+            address if "://" in address else f"tcp://{address}"
+        )
+        self._timeout = timeout
+        self._reconnect_enabled = reconnect
+        self._max_reconnect_attempts = max_reconnect_attempts
+        self._reconnect_base_backoff_s = reconnect_base_backoff_s
+        self._id = 0
+        self._pending_events: list[dict] = []
+        self._subscriptions: set[str] = set()
+        self._closed = False
+        self._connect()
+
+    def _connect(self) -> None:
         import base64
         import os
         import socket
 
-        from tendermint_tpu.p2p.tcp import parse_laddr
-
-        host, port = parse_laddr(
-            address if "://" in address else f"tcp://{address}"
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
         )
-        self._sock = socket.create_connection((host, port), timeout=timeout)
         key = base64.b64encode(os.urandom(16)).decode()
         self._sock.sendall(
             (
-                f"GET /websocket HTTP/1.1\r\nHost: {host}\r\n"
+                f"GET /websocket HTTP/1.1\r\nHost: {self._host}\r\n"
                 "Connection: Upgrade\r\nUpgrade: websocket\r\n"
                 f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
             ).encode()
@@ -131,8 +162,42 @@ class WSClient:
             raise RPCClientError(-32000, f"ws upgrade failed: {status!r}")
         while self._rfile.readline() not in (b"\r\n", b""):
             pass
-        self._id = 0
-        self._pending_events: list[dict] = []
+
+    def _try_reconnect(self) -> bool:
+        """Dial + resubscribe with jittered exponential backoff; False when
+        disabled, closed, or out of attempts."""
+        import time as _time
+
+        if not self._reconnect_enabled or self._closed:
+            return False
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        from tendermint_tpu.utils.backoff import backoff_delay
+
+        for attempt in range(self._max_reconnect_attempts):
+            _time.sleep(
+                backoff_delay(attempt, self._reconnect_base_backoff_s, cap=10.0)
+            )
+            if self._closed:
+                return False
+            try:
+                self._connect()
+                for event in list(self._subscriptions):
+                    self._send("subscribe", event=event)
+                    resp = self._recv_response(self._id, timeout=10)
+                    if resp is None or "error" in resp:
+                        raise RPCClientError(-32000, f"resubscribe failed: {resp}")
+                return True
+            except (OSError, RPCClientError):
+                # don't leak a half-set-up conn when resubscribe fails
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                continue
+        return False
 
     def _send(self, method: str, **params) -> None:
         from tendermint_tpu.rpc.websocket import encode_frame
@@ -177,25 +242,43 @@ class WSClient:
         resp = self._recv_response(self._id, timeout=10)
         if resp is None or "error" in resp:
             raise RPCClientError(-32000, f"subscribe failed: {resp}")
+        self._subscriptions.add(event)
 
     def unsubscribe(self, event: str) -> None:
+        self._subscriptions.discard(event)
         self._send("unsubscribe", event=event)
 
     def events(self, timeout: float = 30.0):
-        """Yield event notification params until timeout/close."""
+        """Yield event notification params until timeout/close. A dead
+        connection triggers transparent reconnect + resubscribe; the
+        iterator only ends on a quiet-period timeout, explicit close, or
+        reconnect exhaustion."""
         while self._pending_events:
             yield self._pending_events.pop(0)
         while True:
             try:
                 msg = self._recv_json(timeout)
-            except (TimeoutError, OSError):
-                return
-            if msg is None:
-                return
+            except TimeoutError:
+                return  # no events within `timeout`: normal iterator end
+            except OSError:
+                if not self._try_reconnect():
+                    return
+                # resubscribe may have buffered events that raced the
+                # subscribe responses — deliver them in order now
+                while self._pending_events:
+                    yield self._pending_events.pop(0)
+                continue
+            if msg is None:  # server closed the stream
+                if not self._try_reconnect():
+                    return
+                while self._pending_events:
+                    yield self._pending_events.pop(0)
+                continue
             if msg.get("method") == "event":
                 yield msg["params"]
 
     def close(self) -> None:
+        self._closed = True
         self._sock.close()
 
 

@@ -245,6 +245,23 @@ def make_routes(node) -> dict:
     def num_unconfirmed_txs() -> dict:
         return {"n_txs": node.mempool.size()}
 
+    def unconfirmed_txs() -> dict:
+        """Pending mempool txs (reference `rpc/core/mempool.go` +
+        `routes.go:22` UnconfirmedTxs)."""
+        txs = node.mempool.reap(-1)
+        return {"n_txs": len(txs), "txs": [bytes(t).hex() for t in txs]}
+
+    def abci_info() -> dict:
+        """App Info over the query conn (reference `rpc/core/abci.go:36-42`,
+        route `routes.go:30`)."""
+        res = node.app_conns.query.info_sync()
+        return {
+            "data": res.data,
+            "version": res.version,
+            "last_block_height": res.last_block_height,
+            "last_block_app_hash": res.last_block_app_hash.hex(),
+        }
+
     def _decode_tx(tx: str) -> bytes:
         try:
             return bytes.fromhex(tx)
@@ -301,13 +318,13 @@ def make_routes(node) -> dict:
         finally:
             node.event_switch.remove_listener(listener_id)
 
-    def tx(hash: str) -> dict:
+    def tx(hash: str, prove: bool = False) -> dict:
         if node.tx_indexer is None:
             raise RPCError(-32000, "tx indexing disabled")
         tr = node.tx_indexer.get(bytes.fromhex(hash))
         if tr is None:
             raise RPCError(-32000, f"tx {hash} not found")
-        return {
+        out = {
             "height": tr.height,
             "index": tr.index,
             "tx": tr.tx.hex(),
@@ -317,6 +334,24 @@ def make_routes(node) -> dict:
                 "log": tr.result.log,
             },
         }
+        if prove:
+            # Rebuild the block's tx tree and serve the inclusion proof
+            # (reference `rpc/core/tx.go` Tx prove + `types/tx.go:71-112`)
+            blk = node.block_store.load_block(tr.height)
+            if blk is None:
+                raise RPCError(-32000, f"block {tr.height} not in store")
+            tx_proof = blk.data.txs.proof(tr.index)
+            out["proof"] = {
+                "root_hash": tx_proof.root_hash.hex(),
+                "data": tx_proof.data.hex(),
+                "proof": {
+                    "index": tx_proof.proof.index,
+                    "total": tx_proof.proof.total,
+                    "leaf": tx_proof.proof.leaf.hex(),
+                    "aunts": [a.hex() for a in tx_proof.proof.aunts],
+                },
+            }
+        return out
 
     def genesis() -> dict:
         import json as _json
@@ -459,7 +494,9 @@ def make_routes(node) -> dict:
         "validators": validators,
         "dump_consensus_state": dump_consensus_state,
         "abci_query": abci_query,
+        "abci_info": abci_info,
         "num_unconfirmed_txs": num_unconfirmed_txs,
+        "unconfirmed_txs": unconfirmed_txs,
         "broadcast_tx_async": broadcast_tx_async,
         "broadcast_tx_sync": broadcast_tx_sync,
         "broadcast_tx_commit": broadcast_tx_commit,

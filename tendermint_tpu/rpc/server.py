@@ -10,6 +10,7 @@ upgrade served off this same listener).
 from __future__ import annotations
 
 import json
+import socket as socket_mod
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlparse
@@ -22,6 +23,40 @@ class RPCError(Exception):
         self.message = message
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can sever live connections on stop.
+
+    Long-lived WebSocket upgrades would otherwise outlive `shutdown()`
+    (which only stops the accept loop), leaving clients half-open and
+    unaware the server is gone."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        self._live: set = set()
+        self._live_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._live_lock:
+            self._live.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._live_lock:
+            live = list(self._live)
+        for sock in live:
+            try:
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+
+
 class RPCServer:
     def __init__(
         self, routes: dict, laddr: str = "tcp://127.0.0.1:46657", event_switch=None
@@ -31,7 +66,7 @@ class RPCServer:
         self.routes = routes
         host, port = parse_laddr(laddr)
         handler = _make_handler(routes, event_switch)
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _TrackingHTTPServer((host, port), handler)
         self.addr = self._httpd.server_address
         self._thread: threading.Thread | None = None
 
@@ -48,6 +83,8 @@ class RPCServer:
     def stop(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        # sever in-flight conns (WS subscribers) so clients see the close
+        self._httpd.close_all_connections()
 
 
 def _make_handler(routes: dict, event_switch=None):

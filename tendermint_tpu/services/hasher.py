@@ -13,24 +13,42 @@ Device trees support sha256; ripemd160 trees fall back to host.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from tendermint_tpu.merkle import simple as host_merkle
+
+# Below this leaf count the ~60 ms per-launch dispatch floor
+# (docs/PLATFORM_NOTES.md) makes host hashlib strictly faster; the device
+# tree only wins on big blocks (BASELINE config 4 is 65k leaves).
+DEVICE_MIN_LEAVES = int(os.environ.get("TENDERMINT_TPU_MIN_DEVICE_LEAVES", "8192"))
 
 
 class TreeHasher:
     """Merkle root/proof builder with host and device backends."""
 
-    def __init__(self, backend: str = "device", algo: str = "sha256") -> None:
+    def __init__(
+        self,
+        backend: str = "device",
+        algo: str = "sha256",
+        min_device_leaves: int | None = None,
+    ) -> None:
         if backend not in ("device", "host"):
             raise ValueError(f"unknown backend {backend!r}")
         self.algo = algo
         # device tree reduction is sha256-only; ripemd160 stays on host
         self.backend = backend if algo == "sha256" else "host"
+        self.min_device_leaves = (
+            DEVICE_MIN_LEAVES if min_device_leaves is None else min_device_leaves
+        )
+
+    def _use_device(self, n: int) -> bool:
+        return self.backend == "device" and n >= max(2, self.min_device_leaves)
 
     def root_from_items(self, items: list[bytes]) -> bytes:
         """SimpleMerkle root over raw byte leaves (leaf-prefixed hashes)."""
-        if self.backend == "device" and len(items) > 1:
+        if self._use_device(len(items)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_device
 
             return merkle_root_device(items)
@@ -38,7 +56,7 @@ class TreeHasher:
 
     def root_from_hashes(self, hashes: list[bytes]) -> bytes:
         """Root over already-hashed leaves (PartSet/Commit aggregation)."""
-        if self.backend == "device" and len(hashes) > 1:
+        if self._use_device(len(hashes)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_from_leaf_words
             from tendermint_tpu.ops.padding import digests_to_bytes_be
 
@@ -62,3 +80,16 @@ def default_hasher() -> TreeHasher:
     if _DEFAULT is None:
         _DEFAULT = TreeHasher()
     return _DEFAULT
+
+
+def auto_hasher() -> TreeHasher:
+    """Device-backed hasher iff a TPU backend is actually up.
+
+    The node composition root calls this once at start so block production
+    (`types/tx.go:33-46` analog) rides the device tree on TPU while CPU-only
+    runs (tests, dev) never pay an XLA compile for host-sized work.
+    """
+    import jax
+
+    backend = "device" if jax.default_backend() == "tpu" else "host"
+    return TreeHasher(backend=backend)

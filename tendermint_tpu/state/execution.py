@@ -27,15 +27,21 @@ class BlockExecutionError(Exception):
 
 
 def validate_block(
-    state: State, block: Block, verifier=None, commit_preverified: bool = False
+    state: State,
+    block: Block,
+    verifier=None,
+    commit_preverified: bool = False,
+    hasher=None,
 ) -> None:
     """Reference `validateBlock` (`state/execution.go:181-206`): header
     fields against state, then LastCommit against LastValidators — the
     latter as one signature batch. `commit_preverified=True` skips the
     LastCommit signature pass ONLY (structure still checked): fast-sync
     batch-verifies whole windows of commits in one device call before
-    applying, so re-verifying per block would double the work."""
-    block.validate_basic()
+    applying, so re-verifying per block would double the work.
+    `hasher` routes the data_hash recomputation through a TreeHasher
+    (device Merkle for big blocks)."""
+    block.validate_basic(hasher)
     if block.header.chain_id != state.chain_id:
         raise ValidationError(
             f"wrong chain_id: got {block.header.chain_id}, want {state.chain_id}"
@@ -102,12 +108,17 @@ def apply_block(
     tx_indexer=None,
     on_tx_result: Callable[[int, bytes, Result], None] | None = None,
     commit_preverified: bool = False,
+    hasher=None,
 ) -> State:
     """Validate, execute, persist; returns the advanced state
     (reference `ApplyBlock state/execution.go:216-249`). Mutates and
     returns `state`; callers pass a copy when they need the original."""
     validate_block(
-        state, block, verifier=verifier, commit_preverified=commit_preverified
+        state,
+        block,
+        verifier=verifier,
+        commit_preverified=commit_preverified,
+        hasher=hasher,
     )
 
     fail_point()  # before any execution effects

@@ -190,6 +190,7 @@ class Block:
         time: int,
         validators_hash: bytes,
         app_hash: bytes,
+        hasher=None,
     ) -> "Block":
         """Build + fill a proposal block (reference `types/block.go:26-45`)."""
         block = cls(
@@ -205,7 +206,7 @@ class Block:
             data=Data(txs=txs),
             last_commit=last_commit,
         )
-        block.fill_header()
+        block.fill_header(hasher)
         return block
 
     def fill_header(self, hasher=None) -> None:
@@ -217,14 +218,14 @@ class Block:
     def hash(self) -> bytes:
         return self.header.hash()
 
-    def make_part_set(self, part_size: int = DEFAULT_PART_SIZE) -> PartSet:
-        return PartSet.from_data(self.encode(), part_size)
+    def make_part_set(self, part_size: int = DEFAULT_PART_SIZE, hasher=None) -> PartSet:
+        return PartSet.from_data(self.encode(), part_size, hasher)
 
     def hash_to(self, other_hash: bytes) -> bool:
         h = self.hash()
         return bool(h) and h == other_hash
 
-    def validate_basic(self) -> None:
+    def validate_basic(self, hasher=None) -> None:
         """Cheap structural checks (reference `ValidateBasic :48-85`)."""
         if self.header.height < 1:
             raise ValidationError("block height must be >= 1")
@@ -234,7 +235,7 @@ class Block:
             raise ValidationError("block at height > 1 missing last_commit")
         if self.header.last_commit_hash != self.last_commit.hash():
             raise ValidationError("last_commit_hash mismatch")
-        if self.header.data_hash != self.data.hash():
+        if self.header.data_hash != self.data.hash(hasher):
             raise ValidationError("data_hash mismatch")
 
     def encode(self) -> bytes:

@@ -84,13 +84,18 @@ class PartSet:
     # -- construction ------------------------------------------------------
 
     @classmethod
-    def from_data(cls, data: bytes, part_size: int = DEFAULT_PART_SIZE) -> "PartSet":
+    def from_data(
+        cls, data: bytes, part_size: int = DEFAULT_PART_SIZE, hasher=None
+    ) -> "PartSet":
         """Split serialized data into Merkle-proved parts
         (reference `NewPartSetFromData types/part_set.go:95-122`)."""
         if part_size <= 0:
             raise ValueError("part_size must be positive")
         chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
-        root, proofs = simple_proofs_from_byte_slices(chunks)
+        if hasher is not None:
+            root, proofs = hasher.proofs(chunks)
+        else:
+            root, proofs = simple_proofs_from_byte_slices(chunks)
         ps = cls(PartSetHeader(total=len(chunks), hash=root))
         for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
             ps._parts[i] = Part(index=i, bytes_=chunk, proof=proof)

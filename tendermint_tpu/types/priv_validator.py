@@ -209,7 +209,10 @@ class PrivValidatorFS(PrivValidator):
             "last_signbytes": self._last.sign_bytes.hex(),
         }
         tmp = self.file_path + ".tmp"
-        with open(tmp, "w") as f:
+        # signing key material: owner-only from creation (reference
+        # WriteFileAtomic 0600), never umask-dependent
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
             json.dump(doc, f, indent=2, sort_keys=True)
             f.flush()
             os.fsync(f.fileno())

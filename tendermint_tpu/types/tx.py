@@ -30,7 +30,7 @@ class Txs(list):
     def hash(self, hasher=None) -> bytes:
         """Merkle root over txs; `hasher` is an optional TreeHasher backend."""
         if hasher is not None:
-            return hasher.hash_leaves(list(self))
+            return hasher.root_from_items(list(self))
         return simple_hash_from_byte_slices(list(self))
 
     def proof(self, i: int) -> "TxProof":

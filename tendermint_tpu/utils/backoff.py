@@ -1,0 +1,13 @@
+"""Jittered exponential backoff (shared by persistent-peer redial and the
+WS client's reconnect; reference retry policies `p2p/switch.go:15-18`,
+`rpc/lib/client/ws_client.go:46-59`)."""
+
+from __future__ import annotations
+
+import random
+
+
+def backoff_delay(attempt: int, base: float, cap: float = 30.0) -> float:
+    """Delay for the given 0-indexed attempt: min(base * 2^attempt, cap)
+    with up to +30% jitter so synchronized retriers fan out."""
+    return min(base * (2**attempt), cap) * (1.0 + 0.3 * random.random())

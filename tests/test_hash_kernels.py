@@ -104,3 +104,29 @@ def test_merkle_forest_mixed_tree_sizes():
     ]
     got = merkle_roots_forest(trees)
     assert got == [simple_hash_from_byte_slices(t) for t in trees]
+
+
+def test_65k_tx_block_data_hash_from_device_tree():
+    """BASELINE config 4 as a production path: a 65k-tx block built through
+    the device TreeHasher gets a data_hash bit-identical to the host tree
+    (reference hot spot `types/tx.go:33-46` via `types/block.go:173-188`)."""
+    from tendermint_tpu.services.hasher import TreeHasher
+    from tendermint_tpu.types import BlockID, Txs
+    from tendermint_tpu.types.block import Block, Commit
+
+    txs = Txs(b"tx-%06d" % i for i in range(65536))
+    dev = TreeHasher(backend="device")  # 65k clears the default threshold
+    block = Block.make_block(
+        height=1,
+        chain_id="kernel-chain",
+        txs=txs,
+        last_commit=Commit.empty(),
+        last_block_id=BlockID.zero(),
+        time=1,
+        validators_hash=b"\x01" * 20,
+        app_hash=b"",
+        hasher=dev,
+    )
+    assert block.header.data_hash == simple_hash_from_byte_slices(list(txs))
+    # and the validation side accepts it through the same device path
+    block.validate_basic(dev)

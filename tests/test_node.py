@@ -151,6 +151,45 @@ class TestTcpTestnet:
                 n.stop()
 
 
+class TestPersistentPeers:
+    def test_reconnects_after_peer_drop(self, tmp_path):
+        """A dropped persistent peer is redialed with backoff until the
+        link heals (reference `reconnectToPeer p2p/switch.go:290-320`) —
+        seeds-only topologies never heal, persistent ones must."""
+        out = str(tmp_path / "net")
+        cli_main(["testnet", "--n", "2", "--output", out, "--starting-port", "0"])
+        cfg0 = Config.test_config(os.path.join(out, "node0"))
+        cfg1 = Config.test_config(os.path.join(out, "node1"))
+        for c in (cfg0, cfg1):
+            c.p2p.pex = False  # isolate: only the persistent logic may redial
+            c.base.fast_sync = False
+        n0 = Node(cfg0)
+        n0.start()
+        try:
+            cfg1.p2p.persistent_peers = f"127.0.0.1:{n0.p2p_port}"
+            cfg1.p2p.reconnect_base_backoff_s = 0.05
+            n1 = Node(cfg1)
+            n1.start()
+            try:
+                wait_until(
+                    lambda: n0.switch.n_peers() == 1 and n1.switch.n_peers() == 1,
+                    timeout=30,
+                    msg="persistent peer connects",
+                )
+                # sever from the remote side: n1's conn dies, and only the
+                # persistent-peer manager may bring it back
+                n0.switch.stop_peer(n0.switch.peers()[0], "test drop")
+                wait_until(
+                    lambda: n0.switch.n_peers() == 1 and n1.switch.n_peers() == 1,
+                    timeout=30,
+                    msg="persistent peer reconnects after drop",
+                )
+            finally:
+                n1.stop()
+        finally:
+            n0.stop()
+
+
 class TestCrashRecovery:
     def test_kill9_and_restart_resumes_chain(self, tmp_path):
         home = str(tmp_path / "crash")

@@ -85,6 +85,55 @@ class TestMConnection:
             ca.stop()
             cb.stop()
 
+    def test_ping_pong_keeps_idle_link_alive(self):
+        """Idle-but-alive peers answer pings, so neither side times out
+        (reference `p2p/connection.go:312-345` keepalive)."""
+        ea, eb = pipe_pair()
+        errs = queue.Queue()
+        kw = dict(ping_interval=0.1, pong_timeout=0.2)
+        ca = MConnection(ea, [ChannelDescriptor(1)], lambda c, p: None,
+                         lambda e: errs.put(("a", e)), **kw)
+        cb = MConnection(eb, [ChannelDescriptor(1)], lambda c, p: None,
+                         lambda e: errs.put(("b", e)), **kw)
+        ca.start()
+        cb.start()
+        try:
+            time.sleep(0.8)  # several ping intervals with zero app traffic
+            assert errs.empty(), f"keepalive failed: {errs.get_nowait()}"
+            assert ca._running and cb._running
+        finally:
+            ca.stop()
+            cb.stop()
+
+    def test_dead_peer_detected_by_ping_timeout(self):
+        """A peer that holds the socket open but never responds must be
+        dropped after ping_interval + pong_timeout — without keepalive it
+        would hold its slot until some send failed."""
+        ea, eb = pipe_pair()  # eb: open but nobody home
+        errs = queue.Queue()
+        ca = MConnection(
+            ea,
+            [ChannelDescriptor(1)],
+            lambda c, p: None,
+            lambda e: errs.put(e),
+            ping_interval=0.1,
+            pong_timeout=0.15,
+        )
+        ca.start()
+        try:
+            exc = errs.get(timeout=3)
+            assert isinstance(exc, TimeoutError)
+            assert not ca._running
+        finally:
+            ca.stop()
+
+    def test_ctrl_channel_id_reserved(self):
+        from tendermint_tpu.p2p.connection import CTRL_CHANNEL
+
+        ea, _eb = pipe_pair()
+        with pytest.raises(ValueError, match="reserved"):
+            MConnection(ea, [ChannelDescriptor(CTRL_CHANNEL)], lambda c, p: None)
+
     def test_on_error_fires_on_link_death(self):
         ea, eb = pipe_pair()
         errs = queue.Queue()

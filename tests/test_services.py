@@ -63,13 +63,13 @@ class TestBatchVerifier:
 class TestTreeHasher:
     def test_device_root_matches_host(self):
         items = [b"item-%d" % i for i in range(13)]
-        assert TreeHasher("device").root_from_items(items) == simple_hash_from_byte_slices(items)
+        assert TreeHasher("device", min_device_leaves=2).root_from_items(items) == simple_hash_from_byte_slices(items)
 
     def test_root_from_hashes(self):
         from tendermint_tpu.merkle.simple import leaf_hash
 
         hashes = [leaf_hash(b"x%d" % i) for i in range(7)]
-        assert TreeHasher("device").root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
+        assert TreeHasher("device", min_device_leaves=2).root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
         assert TreeHasher("host").root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
 
     def test_ripemd_falls_back_to_host(self):
@@ -79,7 +79,7 @@ class TestTreeHasher:
         assert th.root_from_items(items) == simple_hash_from_byte_slices(items, "ripemd160")
 
     def test_edge_counts(self):
-        th = TreeHasher("device")
+        th = TreeHasher("device", min_device_leaves=2)
         assert th.root_from_items([]) == b""
         assert th.root_from_items([b"one"]) == simple_hash_from_byte_slices([b"one"])
 
