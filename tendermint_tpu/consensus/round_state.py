@@ -116,7 +116,9 @@ class HeightVoteSet:
                 self._add_round(r)
             self.round = round_
 
-    def add_vote(self, vote: Vote, peer_id: str = "", verifier=None) -> bool:
+    def add_vote(
+        self, vote: Vote, peer_id: str = "", verifier=None, preverified: bool = False
+    ) -> bool:
         with self._lock:
             if not self._is_vote_allowed(vote, peer_id):
                 return False
@@ -124,7 +126,7 @@ class HeightVoteSet:
             if vs is None:
                 self._add_round(vote.round)
                 vs = self._get(vote.round, vote.type)
-        return vs.add_vote(vote, verifier=verifier)
+        return vs.add_vote(vote, verifier=verifier, preverified=preverified)
 
     def _is_vote_allowed(self, vote: Vote, peer_id: str) -> bool:
         if vote.round <= self.round + 1:

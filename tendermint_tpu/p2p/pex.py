@@ -62,7 +62,12 @@ class PEXReactor(Reactor):
         self.ensure_interval_s = ensure_interval_s
         self._dial_fn = dial_fn
         self._running = False
-        self._requested: set[str] = set()
+        # peer_id -> monotonic time of our last addr request to it.
+        # Requests are re-issuable (rate-limited): a one-shot request can
+        # race the remote book's own fills — e.g. our request reaches B
+        # before B registered a third node — and discovery would deadlock
+        # with both sides waiting for gossip that never re-fires.
+        self._requested: dict[str, float] = {}
         # wakes the ensure loop the moment new addresses arrive, so
         # discovery latency is bounded by gossip, not the poll interval
         # (also what makes multi-node PEX tests deterministic instead of
@@ -94,13 +99,20 @@ class PEXReactor(Reactor):
             )
             if peer.outbound:
                 self.book.mark_good(peer.id)
-        # ask it for more addresses (once per peer)
-        if peer.id not in self._requested:
-            self._requested.add(peer.id)
-            peer.try_send(PEX_CHANNEL, encode_request())
+        # ask it for more addresses
+        self._request_addrs(peer)
+
+    def _request_addrs(self, peer: Peer) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        if now - self._requested.get(peer.id, -1e9) < self.REREQUEST_MIN_S:
+            return
+        self._requested[peer.id] = now
+        peer.try_send(PEX_CHANNEL, encode_request())
 
     def remove_peer(self, peer: Peer, reason) -> None:
-        self._requested.discard(peer.id)
+        self._requested.pop(peer.id, None)
         self._wake.set()  # top back up promptly after a peer drops
 
     def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
@@ -161,6 +173,12 @@ class PEXReactor(Reactor):
     # timeout) so stop() and fresh-gossip wakeups aren't starved
     MAX_DIALS_PER_PASS = 10
 
+    # minimum spacing between addr requests to the SAME peer — re-asking
+    # lets a pass recover from request/registration races without
+    # becoming request spam (reference ensurePeers asks a random peer
+    # whenever the book can't cover the deficit)
+    REREQUEST_MIN_S = 1.0
+
     def ensure_peers(self) -> None:
         """One top-up pass: dial distinct book addresses until the peer
         target is met, candidates run out, or the per-pass dial budget
@@ -178,6 +196,14 @@ class PEXReactor(Reactor):
                 return
             addr = self.book.pick_address(exclude=have | tried)
             if addr is None:
+                # book can't cover the deficit: re-ask a connected peer
+                # for addresses (rate-limited per peer) — the reference's
+                # ensurePeers does the same when short of candidates
+                peers = self.switch.peers()
+                if peers:
+                    import random as _random
+
+                    self._request_addrs(_random.choice(peers))
                 return
             tried.add(addr.node_id)
             self.book.mark_attempt(addr.node_id)

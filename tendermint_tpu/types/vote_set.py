@@ -72,15 +72,20 @@ class VoteSet:
 
     # -- add ----------------------------------------------------------------
 
-    def add_vote(self, vote: Vote | None, verifier=None) -> bool:
+    def add_vote(self, vote: Vote | None, verifier=None, preverified: bool = False) -> bool:
         """Add one vote; returns True if it changed the set. Raises VoteError
-        subclasses on invalid/conflicting votes (reference `AddVote:126-196`)."""
+        subclasses on invalid/conflicting votes (reference `AddVote:126-196`).
+
+        `preverified=True` skips the signature check: the caller already
+        verified this exact (pubkey, sign_bytes, sig) in a device batch
+        (the consensus loop's vote-storm drain) — every structural check
+        still runs."""
         if vote is None:
             raise ValidationError("nil vote")
         with self._lock:
-            return self._add_vote(vote, verifier)
+            return self._add_vote(vote, verifier, preverified)
 
-    def _add_vote(self, vote: Vote, verifier) -> bool:
+    def _add_vote(self, vote: Vote, verifier, preverified: bool = False) -> bool:
         idx = vote.validator_index
         if idx < 0:
             raise ErrVoteInvalidValidatorIndex(f"negative index {idx}")
@@ -102,8 +107,10 @@ class VoteSet:
         if existing is not None and existing.signature == vote.signature:
             return False  # exact duplicate
 
-        # Signature check — host single verify or device batch-of-one.
-        self._verify_signature(vote, val.pub_key, verifier)
+        # Signature check — host single verify or device batch-of-one
+        # (skipped when the receive loop batch-verified this vote already)
+        if not preverified:
+            self._verify_signature(vote, val.pub_key, verifier)
 
         return self._add_verified_vote(vote, val.voting_power)
 

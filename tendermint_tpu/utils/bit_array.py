@@ -52,6 +52,11 @@ class BitArray:
         """Bits set in self but not in other."""
         return BitArray(self._n, self._bits & ~other._bits)
 
+    def count(self) -> int:
+        """Number of set bits."""
+        with self._lock:
+            return self._bits.bit_count()
+
     def is_empty(self) -> bool:
         with self._lock:
             return self._bits == 0
