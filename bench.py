@@ -16,6 +16,24 @@ import time
 
 import numpy as np
 
+# compile once per machine, not per process: the persistent executable
+# cache turns the multi-minute XLA compiles into millisecond loads
+# (utils/jax_cache.py; the r4 79s "table build" was ~95% compile)
+from tendermint_tpu.utils.jax_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+
+def _best_of(fn, reps: int) -> float:
+    """Min wall time over reps — robust to background machine load (the
+    r3->r4 merkle 'regression' was a single noisy sample)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.time()
+        fn()
+        best = min(best, time.time() - t0)
+    return best
+
 
 def _bench_sigs(n_sigs: int):
     sys.stderr.write(f"preparing {n_sigs} signatures...\n")
@@ -61,11 +79,19 @@ def _bench_verify_tables(n_vals: int, stack: int = 64, warm_reps: int = 4) -> di
     pubs, msgs, sigs = _bench_sigs(n_vals)
     pub_arr = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(n_vals, 32)
 
+    # first build: includes the one-time per-process executable
+    # deserialize + device program upload (~25 s through the axon
+    # tunnel even on a compile-cache hit — docs/PLATFORM_NOTES.md)
     t0 = time.time()
     tables, key_ok = build_key_tables(pub_arr)
-    tables.block_until_ready()
-    build_s = time.time() - t0
+    np.asarray(tables[0, 0, 0, :4])  # real sync (block_until_ready no-ops under axon)
+    build_first_s = time.time() - t0
     assert key_ok.all()
+    # steady-state build: what every later valset rotation pays
+    t0 = time.time()
+    tables, key_ok = build_key_tables(pub_arr)
+    np.asarray(tables[0, 0, 0, :4])
+    build_s = time.time() - t0
 
     t0 = time.time()
     s, h, r, pre = prepare_commit_lanes(pubs, [(msgs, sigs)])
@@ -110,22 +136,46 @@ def _bench_verify_tables(n_vals: int, stack: int = 64, warm_reps: int = 4) -> di
         np.asarray(ok2)
         rebuild_s = time.time() - t0
 
+    # 500-key valset rotation: half-thousand NEW keys arrive at once —
+    # the incremental path must device-build just the missing block and
+    # gather the survivors (VERDICT r4 item 4)
+    turnover_s = None
+    if n_vals >= 1000:
+        pubs3 = list(pubs)
+        for i in range(500):
+            pubs3[i * 2] = _gen((b"T%03d" % i).ljust(32, b"\x00")).pub_key.data
+        t0 = time.time()
+        t3, ok3 = svc._tables_for(tuple(pubs3))
+        np.asarray(t3[0, 0, 0, :4])
+        np.asarray(ok3)
+        turnover_s = time.time() - t0
+
     return {
         "rebuild_1key_s": round(rebuild_s, 2),
+        "turnover_500_s": round(turnover_s, 2) if turnover_s else None,
         "n": n_vals,
         "stack": stack,
         "table_build_s": round(build_s, 2),
+        "table_build_first_s": round(build_first_s, 2),
         "host_prep_s": round(prep_s, 4),
         "compile_s": round(compile_s + stack_compile_s, 2),
         "warm_s": one_s,
         "commit_ms": round(one_s * 1e3, 2),
         "stacked_warm_s": stack_s,
+        # marginal cost of one more commit inside a K=stack launch — the
+        # number the BASELINE <2 ms commit target maps to on a device
+        # with a ~60 ms fixed launch floor (docs/PLATFORM_NOTES.md)
+        "commit_marginal_ms": round(stack_s * 1e3 / stack, 2),
         "verifies_per_s": stack * n_vals / stack_s,
     }
 
 
-def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
-    """Generic-ladder path (ad-hoc triples, no cached valset)."""
+def _bench_verify(n_sigs: int, warm_reps: int = 4) -> dict:
+    """Generic-ladder path (ad-hoc triples, no cached valset): the
+    pallas VMEM-resident ladder for >= 1024-lane buckets on TPU
+    (`ops.ed25519_ladder_pallas`), the XLA scan below."""
+    import jax
+
     from tendermint_tpu.ops.ed25519_kernel import bucket_size, prepare_batch, verify_kernel
     from tendermint_tpu.parallel.mesh import pad_to_multiple
 
@@ -135,17 +185,22 @@ def _bench_verify(n_sigs: int, warm_reps: int = 3) -> dict:
     (pub, r, s, h), _, _ = pad_to_multiple(
         [pub, r, s, h], np.zeros(n_sigs, dtype=np.int32), size
     )
+    kernel = verify_kernel
+    if jax.default_backend() == "tpu":
+        from tendermint_tpu.ops.ed25519_ladder_pallas import (
+            MIN_LANES,
+            verify_kernel_pallas,
+        )
+
+        if size >= MIN_LANES:
+            kernel = verify_kernel_pallas
 
     t0 = time.time()
-    out = np.asarray(verify_kernel(pub, r, s, h))
+    out = np.asarray(kernel(pub, r, s, h))
     compile_s = time.time() - t0
     assert out[:n_sigs].all(), "bench batch failed to verify"
 
-    best = float("inf")
-    for _ in range(warm_reps):
-        t0 = time.time()
-        np.asarray(verify_kernel(pub, r, s, h))
-        best = min(best, time.time() - t0)
+    best = _best_of(lambda: np.asarray(kernel(pub, r, s, h)), warm_reps)
     return {
         "n": n_sigs,
         "padded": size,
@@ -168,20 +223,14 @@ def _bench_merkle(n_leaves: int, leaf_bytes: int = 64, stack: int = 16) -> dict:
     root = merkle_root_device(items)
     compile_s = time.time() - t0
     assert root == simple_hash_from_byte_slices(items), "device root != host root"
-    t0 = time.time()
-    merkle_root_device(items)
-    warm = time.time() - t0
+    warm = _best_of(lambda: merkle_root_device(items), 5)
 
     forest = [items] * stack
     t0 = time.time()
     roots = merkle_roots_forest(forest)
     forest_compile_s = time.time() - t0
     assert all(r == root for r in roots)
-    best = float("inf")
-    for _ in range(3):
-        t0 = time.time()
-        merkle_roots_forest(forest)
-        best = min(best, time.time() - t0)
+    best = _best_of(lambda: merkle_roots_forest(forest), 5)
     return {
         "n_leaves": n_leaves,
         "compile_s": round(compile_s + forest_compile_s, 2),
@@ -189,6 +238,45 @@ def _bench_merkle(n_leaves: int, leaf_bytes: int = 64, stack: int = 16) -> dict:
         "stack": stack,
         "forest_warm_s": best,
         "leaves_per_s": stack * n_leaves / best,
+    }
+
+
+def _bench_block_build(n_txs: int = 65_536) -> dict:
+    """End-to-end production seam: a 65k-tx Block built through the node's
+    device TreeHasher (`Block.make_block` -> `Txs.hash` ->
+    `merkle_root_device`), bit-identical to host (BASELINE config 4 as a
+    production path, reference `types/tx.go:33-46`)."""
+    from tendermint_tpu.merkle.simple import simple_hash_from_byte_slices
+    from tendermint_tpu.services.hasher import TreeHasher
+    from tendermint_tpu.types import BlockID, Txs
+    from tendermint_tpu.types.block import Block, Commit
+
+    txs = Txs(b"bench-tx-%06d" % i for i in range(n_txs))
+    dev = TreeHasher(backend="device")
+
+    def build():
+        return Block.make_block(
+            height=1,
+            chain_id="bench-chain",
+            txs=txs,
+            last_commit=Commit.empty(),
+            last_block_id=BlockID.zero(),
+            time=1,
+            validators_hash=b"\x01" * 20,
+            app_hash=b"",
+            hasher=dev,
+        )
+
+    t0 = time.time()
+    blk = build()
+    first_s = time.time() - t0
+    assert blk.header.data_hash == simple_hash_from_byte_slices(list(txs))
+    best = _best_of(build, 3)
+    return {
+        "n_txs": n_txs,
+        "first_s": round(first_s, 2),
+        "block_build_s": best,
+        "txs_per_s": n_txs / best,
     }
 
 
@@ -209,8 +297,14 @@ def main() -> None:
     # realistic heavy-load shape; docs/PLATFORM_NOTES.md has the floor)
     v8k = _bench_verify(8_000)
     sys.stderr.write(f"generic@8k: {v8k}\n")
+    # the big-flush shape: what a light client or cold fast-sync with no
+    # cached tables can push through one pallas-ladder launch
+    v64k = _bench_verify(65_536)
+    sys.stderr.write(f"generic@64k: {v64k}\n")
     m = _bench_merkle(65_536)
     sys.stderr.write(f"merkle@65k: {m}\n")
+    bb = _bench_block_build(65_536)
+    sys.stderr.write(f"block_build@65k: {bb}\n")
 
     target = 1_000_000.0  # BASELINE.md: >=1M ed25519 verifies/s/chip
     result = {
@@ -226,13 +320,18 @@ def main() -> None:
                 t1k["stack"] / t1k["stacked_warm_s"], 1
             ),
             "commit_1k_validators_ms": t1k["commit_ms"],
+            "commit_marginal_ms_at_k64": t10k["commit_marginal_ms"],
             "table_build_10k_s": t10k["table_build_s"],
+            "table_build_first_10k_s": t10k["table_build_first_s"],
             "table_rebuild_1key_s": t10k["rebuild_1key_s"],
+            "table_turnover_500key_s": t10k["turnover_500_s"],
             "host_prep_10k_s": t10k["host_prep_s"],
             "generic_ladder_verifies_per_s": round(v1k["verifies_per_s"], 1),
             "generic_ladder_8k_verifies_per_s": round(v8k["verifies_per_s"], 1),
+            "generic_ladder_64k_verifies_per_s": round(v64k["verifies_per_s"], 1),
             "merkle_leaves_per_s": round(m["leaves_per_s"], 1),
             "merkle_65k_ms": round(m["warm_s"] * 1e3, 2),
+            "block_build_65k_tx_s": round(bb["block_build_s"], 3),
         },
     }
     print(json.dumps(result))

@@ -51,6 +51,11 @@ def _cmd_node(args) -> int:
     """Run a node until interrupted (reference `commands/run_node.go`)."""
     from tendermint_tpu.config import load_config
     from tendermint_tpu.node import Node
+    from tendermint_tpu.utils.jax_cache import enable_persistent_cache
+
+    # kernels (table builds, verify, merkle) compile once per MACHINE:
+    # restarts deserialize from the on-disk executable cache
+    enable_persistent_cache()
 
     cfg = load_config(args.home)
     if args.p2p_laddr:

@@ -53,6 +53,13 @@ class Node:
     ) -> None:
         self.config = config
         cfg = config
+        if verifier is None:
+            # resolve the process default ONCE (device tables on TPU,
+            # host library elsewhere) so warming and every component
+            # share the same instance — the CLI path passes None
+            from tendermint_tpu.services.verifier import default_verifier
+
+            verifier = default_verifier()
         from tendermint_tpu.utils.log import setup_logging
 
         setup_logging(cfg.base.log_level)
@@ -141,6 +148,10 @@ class Node:
 
             hasher = auto_hasher()
         self.hasher = hasher
+        # background-load the table-build executable so the first real
+        # valset build doesn't stall on the per-process program upload
+        if hasattr(verifier, "warm_kernels"):
+            verifier.warm_kernels()
 
         self.consensus = ConsensusState(
             config=cfg.consensus,

@@ -549,6 +549,9 @@ def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
 
     Replaces the reference's sequential loop in
     `types/validator_set.go:236-261` / `types/vote_set.go:137-196`.
+    Batches that pad to >= 1024 lanes take the Pallas ladder
+    (VMEM-resident accumulator, `ops.ed25519_ladder_pallas`) on TPU;
+    smaller ones the portable XLA scan.
     """
     n = len(pubkeys)
     if n == 0:
@@ -562,5 +565,14 @@ def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
             return np.concatenate([a, np.zeros((pad, 32), dtype=np.uint8)])
 
         pub, r, s, h = _pad(pub), _pad(r), _pad(s), _pad(h)
+    if jax.default_backend() == "tpu":
+        from tendermint_tpu.ops.ed25519_ladder_pallas import (
+            MIN_LANES,
+            verify_kernel_pallas,
+        )
+
+        if size >= MIN_LANES:
+            verdict = np.asarray(verify_kernel_pallas(pub, r, s, h))[:n]
+            return verdict & precheck
     verdict = np.asarray(verify_kernel(pub, r, s, h))[:n]
     return verdict & precheck

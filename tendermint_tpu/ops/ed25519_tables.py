@@ -917,24 +917,41 @@ def prepare_commit_lanes(pubkeys, commits):
     h = np.zeros((k * n, 32), dtype=np.uint8)
     r = np.zeros((k * n, 32), dtype=np.uint8)
     precheck = np.zeros(k * n, dtype=bool)
+    # hot loop kept lean (10k+ lanes on the single-commit latency path):
+    # one bytes-join + frombuffer per commit instead of per-lane array
+    # writes, hashlib only on present lanes
+    zero64 = b"\x00" * 64
+    from_bytes = int.from_bytes
+    sha512 = hashlib.sha512
     for ci, (msgs, sigs) in enumerate(commits):
         if len(msgs) != n or len(sigs) != n:
             raise ValueError(f"commit {ci}: expected {n} lanes")
+        lanes = precheck[ci * n : (ci + 1) * n]
+        hrows = []
+        sig_blob = []
         for i in range(n):
             msg, sig = msgs[i], sigs[i]
-            if msg is None or sig is None:
+            if (
+                msg is None
+                or sig is None
+                or len(sig) != 64
+                or len(pubkeys[i]) != 32
+                or from_bytes(sig[32:], "little") >= _L
+            ):
+                sig_blob.append(zero64)
                 continue
-            if len(sig) != 64 or len(pubkeys[i]) != 32:
-                continue
-            if int.from_bytes(sig[32:], "little") >= _L:
-                continue
-            lane = ci * n + i
-            precheck[lane] = True
-            r[lane] = np.frombuffer(sig[:32], dtype=np.uint8)
-            s[lane] = np.frombuffer(sig[32:], dtype=np.uint8)
-            hh = hashlib.sha512(sig[:32] + pubkeys[i] + msg).digest()
-            h[lane] = np.frombuffer(
-                (int.from_bytes(hh, "little") % _L).to_bytes(32, "little"),
-                dtype=np.uint8,
+            lanes[i] = True
+            sig_blob.append(sig)
+            hh = sha512(sig[:32] + pubkeys[i] + msg).digest()
+            hrows.append(
+                (i, (from_bytes(hh, "little") % _L).to_bytes(32, "little"))
             )
+        sig_arr = np.frombuffer(b"".join(sig_blob), dtype=np.uint8).reshape(n, 64)
+        r[ci * n : (ci + 1) * n] = sig_arr[:, :32]
+        s[ci * n : (ci + 1) * n] = sig_arr[:, 32:]
+        if hrows:
+            idx, blobs = zip(*hrows)
+            h[ci * n + np.asarray(idx, dtype=np.intp)] = np.frombuffer(
+                b"".join(blobs), dtype=np.uint8
+            ).reshape(len(blobs), 32)
     return s, h, r, precheck

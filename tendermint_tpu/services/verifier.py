@@ -116,10 +116,10 @@ class TableBatchVerifier(DeviceBatchVerifier):
     triples (proposal sigs, mixed-key batches).
     """
 
-    # diffs up to this many NEW keys rebuild incrementally: unchanged
-    # columns are gathered from the cached tables on device and only the
-    # new keys are built (host-side — faster than the device build
-    # kernel below ~100 keys and compile-free)
+    # diffs up to this many NEW keys build host-side (0.14 s/key,
+    # compile-free); larger diffs build the missing keys as one device
+    # kernel call (~0.7 s warm per 2048 keys — the persistent XLA cache
+    # keeps even a fresh process warm, utils/jax_cache.py)
     MAX_INCREMENTAL_KEYS = 128
 
     def __init__(self, cache_size: int = 4, min_device_batch: int | None = None) -> None:
@@ -161,11 +161,18 @@ class TableBatchVerifier(DeviceBatchVerifier):
             return None
         hits, pos, old_t, old_ok = best
         missing = [pk for pk in pubkeys if pk not in pos]
-        if len(missing) > self.MAX_INCREMENTAL_KEYS:
-            return None
         if missing:
-            new_t, new_ok = host_build_key_tables(missing)
-            combined = jnp.concatenate([old_t, jnp.asarray(new_t)], axis=3)
+            if len(missing) <= self.MAX_INCREMENTAL_KEYS:
+                new_t, new_ok = host_build_key_tables(missing)
+                new_t = jnp.asarray(new_t)
+            else:
+                # big turnover (e.g. a 500-key valset rotation): the
+                # device build kernel beats 0.14 s/key host work
+                from tendermint_tpu.ops.ed25519_tables import build_key_tables
+
+                miss_arr = np.frombuffer(b"".join(missing), dtype=np.uint8)
+                new_t, new_ok = build_key_tables(miss_arr.reshape(-1, 32))
+            combined = jnp.concatenate([old_t, new_t], axis=3)
             ok_comb = np.concatenate([old_ok, new_ok])
         else:  # same keys, different order/subset: pure gather
             combined, ok_comb = old_t, old_ok
@@ -200,6 +207,34 @@ class TableBatchVerifier(DeviceBatchVerifier):
                 self._tables.popitem(last=False)
         return tables, ok
 
+    def warm_kernels(self) -> None:
+        """Background-load the chunked build executable (one dummy
+        2048-key device build) so the FIRST real valset build doesn't
+        pay the ~25 s per-process executable upload (the compile itself
+        is served by the persistent cache — utils/jax_cache.py). Called
+        by the node at startup on TPU backends."""
+        import threading
+
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return  # XLA:CPU would burn minutes compiling a kernel this
+            # host will never use at scale (docs/PLATFORM_NOTES.md)
+
+        def _warm():
+            try:
+                import numpy as _np
+
+                from tendermint_tpu.ops.ed25519_tables import build_key_tables
+
+                dummy = _np.zeros((2048, 32), dtype=_np.uint8)
+                dummy[:, 0] = 1  # identity encodings: decompress cleanly
+                build_key_tables(dummy)
+            except Exception:
+                pass  # warming is best-effort
+
+        threading.Thread(target=_warm, daemon=True, name="warm-build-kernel").start()
+
     def prebuild(self, pubkeys) -> None:
         """Warm the table cache for a validator set in the background —
         called when a valset rotation is decided (EndBlock diffs) so the
@@ -218,6 +253,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
         self,
         pubkeys: Sequence[bytes],
         commits: Sequence[tuple[Sequence[bytes | None], Sequence[bytes | None]]],
+        force_fused: bool | None = None,
     ) -> np.ndarray:
         """K commits over one N-validator set -> (K, N) bool verdicts.
 
@@ -228,6 +264,9 @@ class TableBatchVerifier(DeviceBatchVerifier):
         (`types/validator_set.go:236-261`) with one K*N-lane device
         batch against cached tables; fast-sync stacks many commits of
         the same valset into a single call (BASELINE config 3).
+
+        `force_fused` overrides the fused-shaping decision (tests gate
+        the chunk/pad logic on the CPU mesh with it); None = auto.
         """
         from tendermint_tpu.ops.ed25519_tables import (
             prepare_commit_lanes,
@@ -266,10 +305,21 @@ class TableBatchVerifier(DeviceBatchVerifier):
         # The fused pallas path wants K in multiples of 8 (lane planes
         # are (8, 16K)) up to MAX_FUSED_STACK; pad with absent-vote
         # commits (verify False, masked by precheck) and chunk larger
-        # windows so every launch takes the fast path.
+        # windows so every launch takes the fast path. Only shape for it
+        # when it actually wins: off-TPU the kernel never selects fused
+        # (padding would be pure wasted lanes), and below K=8 the fused
+        # launch's fixed cost (~107 ms: table read + dispatch,
+        # docs/PLATFORM_NOTES.md) exceeds the materialized K-small path
+        # (~63 ms) — single-commit latency is the consensus loop's.
+        import jax
+
         from tendermint_tpu.ops.ed25519_tables import MAX_FUSED_STACK
 
-        fusable = n % 128 == 0
+        fusable = (
+            (n % 128 == 0 and k >= 8 and jax.default_backend() == "tpu")
+            if force_fused is None
+            else force_fused
+        )
         out_rows = []
         chunk = MAX_FUSED_STACK if fusable else len(commits)
         for lo in range(0, k, chunk):

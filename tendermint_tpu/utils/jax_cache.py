@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache.
+
+The cold-start killer on this platform is XLA compile time, not device
+work: the 10k-key table build is ~3.3 s warm but ~80 s cold because the
+build kernel compiles per process (docs/PLATFORM_NOTES.md). The
+persistent cache serializes compiled executables to disk so every
+process after the first deserializes in milliseconds — the TPU analog
+of the reference shipping precompiled binaries.
+
+Called from every entry point that compiles kernels (node CLI, bench,
+graft entries, tools). Opt out with TENDERMINT_TPU_XLA_CACHE=off.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.expanduser("~/.cache/tendermint_tpu/xla")
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX at the on-disk executable cache. Idempotent; returns the
+    cache dir or None when disabled via env."""
+    global _enabled
+    env = os.environ.get("TENDERMINT_TPU_XLA_CACHE", "")
+    if env.lower() in ("off", "0", "disable"):
+        return None
+    if _enabled:
+        return cache_dir or env or _DEFAULT_DIR
+    path = cache_dir or (env if env else _DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # every kernel here is worth caching: even "fast" compiles are tens
+    # of launch floors on this device
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = True
+    return path

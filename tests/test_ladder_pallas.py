@@ -1,0 +1,210 @@
+"""Pallas generic-ladder kernel correctness
+(`ops/ed25519_ladder_pallas` — the ad-hoc verify fast path; reference
+semantics `types/validator_set.go:284-349` VerifyCommitAny).
+
+The kernel body is plain plane-list math (`_double_planes`,
+`_madd_planes`, 4-way masked select) — these tests run EXACTLY that
+code as jnp ops against the XLA scan kernel, so the algorithm is gated
+on CPU without pallas interpret mode (measured >10 min per 1024-lane
+interpreted call — unusable as a test budget). The pallas-call
+mechanics (BlockSpecs, grid, VMEM scratch) are exercised on real TPU
+runs (bench + the tpu-gated test below)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tendermint_tpu.crypto.keys import gen_priv_key
+from tendermint_tpu.ops.ed25519_kernel import (
+    NLIMBS,
+    pt_double,
+    prepare_batch,
+    verify_kernel,
+)
+
+pytestmark = pytest.mark.kernel
+
+
+def _batch(n, corrupt=(), bad_pub=(), bad_r=()):
+    privs = [gen_priv_key(bytes([i % 250 + 1, i // 250 + 1]) + b"\0" * 30) for i in range(n)]
+    msgs = [b"ladder-%d" % i for i in range(n)]
+    sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+    pubs = [p.pub_key.data for p in privs]
+    for i in corrupt:
+        sigs[i] = sigs[i][:8] + bytes([sigs[i][8] ^ 1]) + sigs[i][9:]
+    for i in bad_pub:
+        pubs[i] = b"\xff" * 32  # non-canonical y
+    for i in bad_r:
+        sigs[i] = bytes([sigs[i][0] ^ 1]) + sigs[i][1:]  # corrupt R
+    return pubs, msgs, sigs
+
+
+def _planes_from_limbs(a):
+    """(B, 20) limb array -> list of 20 (8, B//8) planes (kernel layout)."""
+    b = a.shape[0]
+    return [a[:, i].reshape(8, b // 8) for i in range(NLIMBS)]
+
+
+def _limbs_from_planes(planes):
+    return jnp.stack([p.reshape(-1) for p in planes], axis=-1)
+
+
+class TestKernelMath:
+    def test_double_planes_matches_pt_double(self):
+        """The kernel's extended doubling (new in round 5) must match
+        pt_double bit-for-bit on random on-curve points."""
+        from tendermint_tpu.ops.ed25519_ladder_pallas import _double_planes
+
+        n = 64
+        privs = [gen_priv_key(bytes([i + 1]) * 32) for i in range(n)]
+        pubs = np.stack(
+            [np.frombuffer(p.pub_key.data, dtype=np.uint8) for p in privs]
+        )
+        from tendermint_tpu.ops.ed25519_kernel import fe_canon, pt_decompress
+
+        pt, ok = pt_decompress(jnp.asarray(pubs))
+        assert np.asarray(ok).all()
+        want = pt_double(pt)
+        got_planes = _double_planes(tuple(_planes_from_limbs(c) for c in pt))
+        got = tuple(_limbs_from_planes(p) for p in got_planes)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(
+                np.asarray(fe_canon(w)), np.asarray(fe_canon(g))
+            )
+
+    def test_ladder_semantics_on_host_bigints(self):
+        """Prove the kernel's ALGORITHM — msb-first digit schedule +
+        {O, B, -A, B-A} entry mapping + double-then-add recurrence —
+        computes [S]B + [h](-A), by emulating the exact per-step
+        recurrence with host big-int point arithmetic on the module's
+        own `_ladder_digits` output. Pure host: no XLA compile (the
+        plane-op step body is gated by test_double_planes and the fused
+        kernel suites; pallas plumbing by TPU runs/bench)."""
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import ed25519_ladder_pallas as lp
+        from tendermint_tpu.ops.ed25519_kernel import BX, BY, L, P
+        from tendermint_tpu.ops.ed25519_tables import (
+            _hadd,
+            _host_decompress,
+            host_affine,
+            host_scalar_mul,
+        )
+
+        n = 4
+        privs = [gen_priv_key(bytes([7 * i + 1]) * 32) for i in range(n)]
+        msgs = [b"sem-%d" % i for i in range(n)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        pubs = [p.pub_key.data for p in privs]
+        pub, r, s, h, pre = prepare_batch(pubs, msgs, sigs)
+        assert pre.all()
+
+        dig = np.asarray(lp._ladder_digits(jnp.asarray(s), jnp.asarray(h)))
+        b_ext = (BX, BY, 1, BX * BY % P)
+        ident = (0, 1, 1, 0)
+        for lane in range(n):
+            ax, ay = _host_decompress(pubs[lane])
+            neg_a = (P - ax, ay, 1, (P - ax) * ay % P)
+            table = [ident, b_ext, neg_a, _hadd(b_ext, neg_a)]
+            acc = ident
+            for t in range(dig.shape[1]):
+                acc = _hadd(acc, acc)  # double
+                acc = _hadd(acc, table[dig[lane, t]])
+            s_int = int.from_bytes(bytes(sigs[lane][32:]), "little")
+            h_int = int.from_bytes(bytes(h[lane]), "little")
+            assert s_int < L and h_int < L
+            want = _hadd(
+                host_scalar_mul(s_int, b_ext), host_scalar_mul(h_int, neg_a)
+            )
+            assert host_affine(acc) == host_affine(want), f"lane {lane}"
+
+    def test_build_inputs_entries_match_host_precomp(self):
+        """The prologue's per-lane gtab rows must hold the affine
+        ypx/ymx/t2d precomp of {O, B, -A, B-A} exactly (host-int cross
+        check, eager — a handful of lanes, no kernel compile)."""
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import ed25519_ladder_pallas as lp
+        from tendermint_tpu.ops.ed25519_kernel import BX, BY, D2, P, _limbs_to_int
+        from tendermint_tpu.ops.ed25519_tables import (
+            _hadd,
+            _host_decompress,
+            host_affine,
+        )
+
+        n = 1024  # _tile_lanes minimum; eager decompress is the cost
+        privs = [gen_priv_key(bytes([i % 250 + 1, i // 250 + 2]) + b"\0" * 30) for i in range(n)]
+        pubs = [p.pub_key.data for p in privs]
+        msgs = [b"pre-%d" % i for i in range(n)]
+        sigs = [p.sign(m) for p, m in zip(privs, msgs)]
+        pub, r, s, h, _pre = prepare_batch(pubs, msgs, sigs)
+        gtab, dig, a_ok = lp._build_inputs(
+            jnp.asarray(pub), jnp.asarray(s), jnp.asarray(h), 1024
+        )
+        assert np.asarray(a_ok).all()
+        gt = np.asarray(gtab)  # (1, 4, 60, 8, 128)
+
+        def precomp(x, y):
+            return ((y + x) % P, (y - x) % P, D2 * x % P * y % P)
+
+        b_ext = (BX, BY, 1, BX * BY % P)
+        for lane in (0, 1, 511, 1023):
+            row, col = lane // 128, lane % 128
+            ax, ay = _host_decompress(pubs[lane])
+            neg_a = (P - ax, ay, 1, (P - ax) * ay % P)
+            expected = [
+                (1, 1, 0),
+                precomp(BX, BY),
+                precomp(P - ax, ay),
+                precomp(*host_affine(_hadd(b_ext, neg_a))),
+            ]
+            for e in range(4):
+                got = [
+                    _limbs_to_int(gt[0, e, 20 * c : 20 * (c + 1), row, col])
+                    for c in range(3)
+                ]
+                assert got == list(expected[e]), (lane, e)
+    @pytest.mark.skipif(
+        jax.default_backend() != "tpu", reason="pallas mechanics need a real TPU"
+    )
+    def test_full_kernel_on_tpu(self):
+        from tendermint_tpu.ops.ed25519_ladder_pallas import verify_kernel_pallas
+
+        n = 1024
+        pubs, msgs, sigs = _batch(n, corrupt={5}, bad_pub={7})
+        pub, r, s, h, pre = prepare_batch(pubs, msgs, sigs)
+        got = np.asarray(verify_kernel_pallas(pub, r, s, h))
+        expect = np.ones(n, dtype=bool)
+        expect[5] = expect[7] = False
+        assert (got == expect).all()
+
+
+class TestRouting:
+    def test_batch_verify_routes_by_backend_and_size(self, monkeypatch):
+        """batch_verify must take the pallas ladder only on TPU and only
+        when the padded batch clears the 1024-lane plane geometry."""
+        import tendermint_tpu.ops.ed25519_kernel as ek
+        import tendermint_tpu.ops.ed25519_ladder_pallas as lpk
+
+        calls = []
+        monkeypatch.setattr(
+            lpk,
+            "verify_kernel_pallas",
+            lambda pub, r, s, h, **k: (
+                calls.append(pub.shape[0]),
+                ek.verify_kernel(pub, r, s, h),
+            )[1],
+        )
+        monkeypatch.setattr(ek.jax, "default_backend", lambda: "tpu")
+        pubs, msgs, sigs = _batch(1000, corrupt={7})
+        out = ek.batch_verify(pubs, msgs, sigs)
+        assert calls == [1024]  # padded to the pallas bucket
+        assert not out[7] and out.sum() == 999
+
+        # small batches stay on the XLA kernel even on TPU
+        calls.clear()
+        pubs, msgs, sigs = _batch(64)
+        out = ek.batch_verify(pubs, msgs, sigs)
+        assert calls == [] and out.all()
