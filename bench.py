@@ -185,15 +185,12 @@ def _bench_verify(n_sigs: int, warm_reps: int = 4) -> dict:
     (pub, r, s, h), _, _ = pad_to_multiple(
         [pub, r, s, h], np.zeros(n_sigs, dtype=np.int32), size
     )
-    kernel = verify_kernel
-    if jax.default_backend() == "tpu":
-        from tendermint_tpu.ops.ed25519_ladder_pallas import (
-            MIN_LANES,
-            verify_kernel_pallas,
-        )
+    from tendermint_tpu.ops.ed25519_ladder_pallas import (
+        use_pallas_ladder,
+        verify_kernel_pallas,
+    )
 
-        if size >= MIN_LANES:
-            kernel = verify_kernel_pallas
+    kernel = verify_kernel_pallas if use_pallas_ladder(size) else verify_kernel
 
     t0 = time.time()
     out = np.asarray(kernel(pub, r, s, h))

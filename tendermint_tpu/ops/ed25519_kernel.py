@@ -565,14 +565,13 @@ def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
             return np.concatenate([a, np.zeros((pad, 32), dtype=np.uint8)])
 
         pub, r, s, h = _pad(pub), _pad(r), _pad(s), _pad(h)
-    if jax.default_backend() == "tpu":
-        from tendermint_tpu.ops.ed25519_ladder_pallas import (
-            MIN_LANES,
-            verify_kernel_pallas,
-        )
+    from tendermint_tpu.ops.ed25519_ladder_pallas import (
+        use_pallas_ladder,
+        verify_kernel_pallas,
+    )
 
-        if size >= MIN_LANES:
-            verdict = np.asarray(verify_kernel_pallas(pub, r, s, h))[:n]
-            return verdict & precheck
-    verdict = np.asarray(verify_kernel(pub, r, s, h))[:n]
+    if use_pallas_ladder(size):
+        verdict = np.asarray(verify_kernel_pallas(pub, r, s, h))[:n]
+    else:
+        verdict = np.asarray(verify_kernel(pub, r, s, h))[:n]
     return verdict & precheck

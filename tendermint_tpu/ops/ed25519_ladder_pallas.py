@@ -76,6 +76,15 @@ MAX_TILE_LANES = 4096
 MIN_LANES = 1024  # smallest plane geometry (8, 128)
 
 
+def use_pallas_ladder(padded_size: int) -> bool:
+    """THE routing rule for generic verifies — shared by batch_verify
+    and bench so they can't drift: pallas ladder iff the padded bucket
+    clears the plane geometry and a TPU is the backend."""
+    import jax
+
+    return padded_size >= MIN_LANES and jax.default_backend() == "tpu"
+
+
 def _sq_planes(a):
     return _mul_planes(a, a)
 

@@ -61,19 +61,59 @@ def inner_hash_device(L, R):
     return sha256_fixed2_from_words(b0, b1)
 
 
-def _forest_levels(nodes, cnt, levels: int):
-    """Shared level reduction: nodes (T, P, 8) u32, cnt (T,) i32 valid leaf
-    prefixes, P = 2**levels. Returns (T, 8) root words. A pair exists only
+_B8_LE = jnp.uint32(8)
+_B24_LE = jnp.uint32(24)
+
+
+def _inner_node_words_ripemd(L, R):
+    """(B,5),(B,5) u32 LE digests -> (B,16) u32 LE single-block message
+    for H(0x01 || L20 || R20) = 41 bytes (fits one RIPEMD-160 block:
+    0x80 at byte 41, bit length 328 LE at words 14-15)."""
+    b = L.shape[0]
+    w = [jnp.uint32(INNER_PREFIX[0]) | (L[:, 0] << _B8_LE)]
+    for i in range(1, 5):
+        w.append((L[:, i - 1] >> _B24_LE) | (L[:, i] << _B8_LE))
+    w.append((L[:, 4] >> _B24_LE) | (R[:, 0] << _B8_LE))
+    for i in range(1, 5):
+        w.append((R[:, i - 1] >> _B24_LE) | (R[:, i] << _B8_LE))
+    w.append((R[:, 4] >> _B24_LE) | jnp.uint32(0x80 << 8))
+    zero = jnp.zeros((b,), dtype=jnp.uint32)
+    w += [zero, zero, zero]
+    w.append(jnp.full((b,), np.uint32(41 * 8), dtype=jnp.uint32))
+    w.append(zero)
+    return jnp.stack(w, axis=1)
+
+
+def ripemd_inner_hash_device(L, R):
+    """(B,5),(B,5) -> (B,5): batched RIPEMD-160 inner-node hash."""
+    from tendermint_tpu.ops.ripemd160_kernel import _ripemd160_masked
+
+    block = _inner_node_words_ripemd(L, R)
+    ones = jnp.ones((L.shape[0],), dtype=jnp.int32)
+    return _ripemd160_masked(block[:, None, :], ones, 1)
+
+
+_ALGOS = {
+    # algo -> (digest words, inner-node hash)
+    "sha256": (8, inner_hash_device),
+    "ripemd160": (5, ripemd_inner_hash_device),
+}
+
+
+def _forest_levels(nodes, cnt, levels: int, algo: str = "sha256"):
+    """Shared level reduction: nodes (T, P, W) u32, cnt (T,) i32 valid leaf
+    prefixes, P = 2**levels. Returns (T, W) root words. A pair exists only
     if its right child is inside the valid prefix; an unpaired trailing
     node is promoted (== left child unchanged)."""
+    width, inner = _ALGOS[algo]
     t = nodes.shape[0]
     for _ in range(levels):
         left = nodes[:, 0::2]
         right = nodes[:, 1::2]
         half = left.shape[1]
-        paired = inner_hash_device(
-            left.reshape(t * half, 8), right.reshape(t * half, 8)
-        ).reshape(t, half, 8)
+        paired = inner(
+            left.reshape(t * half, width), right.reshape(t * half, width)
+        ).reshape(t, half, width)
         idx = jnp.arange(half, dtype=jnp.int32)
         nodes = jnp.where(
             (2 * idx[None, :] + 1 < cnt[:, None])[..., None], paired, left
@@ -113,8 +153,10 @@ def merkle_root_from_leaf_words(leaf_digests, count=None):
     return _tree_reduce(leaf_digests, jnp.asarray(count, dtype=jnp.int32), levels)
 
 
-@partial(jax.jit, static_argnames=("max_blocks", "levels"))
-def _leafhash_and_reduce(blocks, n_blocks, counts, max_blocks: int, levels: int):
+@partial(jax.jit, static_argnames=("max_blocks", "levels", "algo"))
+def _leafhash_and_reduce(
+    blocks, n_blocks, counts, max_blocks: int, levels: int, algo: str = "sha256"
+):
     """Fused leaf hashing + forest reduction: ONE device launch.
 
     blocks:   (T, P, max_blocks, 16) u32 padded leaf messages, P = 2**levels
@@ -127,25 +169,37 @@ def _leafhash_and_reduce(blocks, n_blocks, counts, max_blocks: int, levels: int)
     leaf SHA-256 pass and all log2(P) tree levels must ship as a single
     executable rather than one call per stage.
     """
-    from tendermint_tpu.ops.sha256_kernel import _sha256_masked
-
     t, p = blocks.shape[0], blocks.shape[1]
     flat = blocks.reshape(t * p, max_blocks, 16)
-    digs = _sha256_masked(flat, n_blocks.reshape(-1), max_blocks)
-    return _forest_levels(digs.reshape(t, p, 8), counts, levels)
+    if algo == "ripemd160":
+        from tendermint_tpu.ops.ripemd160_kernel import _ripemd160_masked
+
+        digs = _ripemd160_masked(flat, n_blocks.reshape(-1), max_blocks)
+    else:
+        from tendermint_tpu.ops.sha256_kernel import _sha256_masked
+
+        digs = _sha256_masked(flat, n_blocks.reshape(-1), max_blocks)
+    width = _ALGOS[algo][0]
+    return _forest_levels(digs.reshape(t, p, width), counts, levels, algo)
 
 
-def merkle_roots_forest(trees: list[list[bytes]]) -> list[bytes]:
+def merkle_roots_forest(
+    trees: list[list[bytes]], algo: str = "sha256"
+) -> list[bytes]:
     """Batched device tree build: one root per item list, ONE device call.
 
     All trees pad to a common (P, max_blocks) shape — the fast-sync /
     mempool-flood shape (BASELINE config 4: batched Txs.Hash + PartSet
     roots) where many blocks' trees build concurrently. Bit-equal to
-    `merkle.simple.simple_hash_from_byte_slices` (sha256 algo) per tree.
+    `merkle.simple.simple_hash_from_byte_slices` per tree; `algo` picks
+    sha256 (the framework's target variant) or ripemd160 (the
+    reference's bit-compat variant, `docs/specification/merkle.rst`).
     """
     from tendermint_tpu.ops.padding import (
         bucket_blocks,
         digests_to_bytes_be,
+        digests_to_bytes_le,
+        pad_ripemd160_prefixed,
         pad_sha256_prefixed,
     )
 
@@ -161,7 +215,12 @@ def merkle_roots_forest(trees: list[list[bytes]]) -> list[bytes]:
         p *= 2
     levels = p.bit_length() - 1
     flat = [x for items in trees for x in items]
-    blocks, n_blocks = pad_sha256_prefixed(flat, LEAF_PREFIX)
+    if algo == "ripemd160":
+        blocks, n_blocks = pad_ripemd160_prefixed(flat, LEAF_PREFIX)
+        to_bytes = digests_to_bytes_le
+    else:
+        blocks, n_blocks = pad_sha256_prefixed(flat, LEAF_PREFIX)
+        to_bytes = digests_to_bytes_be
     mb = blocks.shape[1]
     # bucket the forest size so varying tree counts reuse compiled shapes
     # (pad trees are all-masked rows; their garbage roots are sliced off)
@@ -175,15 +234,17 @@ def merkle_roots_forest(trees: list[list[bytes]]) -> list[bytes]:
         all_blocks[i, :c] = blocks[off : off + c]
         all_nblocks[i, :c] = n_blocks[off : off + c]
         off += c
-    roots = _leafhash_and_reduce(all_blocks, all_nblocks, all_counts, mb, levels)
-    return digests_to_bytes_be(np.asarray(roots)[:t])
+    roots = _leafhash_and_reduce(
+        all_blocks, all_nblocks, all_counts, mb, levels, algo
+    )
+    return to_bytes(np.asarray(roots)[:t])
 
 
-def merkle_root_device(items: list[bytes]) -> bytes:
+def merkle_root_device(items: list[bytes], algo: str = "sha256") -> bytes:
     """Host convenience: full device tree build over raw byte items.
 
-    Bit-equal to `merkle.simple.simple_hash_from_byte_slices` (sha256 algo).
+    Bit-equal to `merkle.simple.simple_hash_from_byte_slices`.
     """
     if not items:
         return b""
-    return merkle_roots_forest([items])[0]
+    return merkle_roots_forest([items], algo)[0]

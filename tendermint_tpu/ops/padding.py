@@ -111,7 +111,14 @@ def pad_sha512(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.nda
 
 def pad_ripemd160(msgs: list[bytes], max_blocks: int | None = None) -> tuple[np.ndarray, np.ndarray]:
     """-> (blocks[B, max_blocks, 16] u32 little-endian words, n_blocks[B] i32)."""
-    padded = [_md_pad(m, 64, 8, length_le=True) for m in msgs]
+    return pad_ripemd160_prefixed(msgs, b"", max_blocks)
+
+
+def pad_ripemd160_prefixed(
+    msgs: list[bytes], prefix: bytes = b"", max_blocks: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """RIPEMD-160 padding of `prefix || msg` (LE words, LE bit length)."""
+    padded = [_md_pad(prefix + m, 64, 8, length_le=True) for m in msgs]
     counts = np.array([len(p) // 64 for p in padded], dtype=np.int32)
     mb = max_blocks if max_blocks is not None else bucket_blocks(int(counts.max(initial=1)))
     out = np.zeros((len(msgs), mb, 16), dtype=np.uint32)

@@ -8,7 +8,8 @@ host backend is the bit-identical sequential reference.
 
 The reference's tree uses RIPEMD-160 (`docs/specification/merkle.rst`);
 this framework's target variant is SHA-256 (BASELINE.md north star).
-Device trees support sha256; ripemd160 trees fall back to host.
+Device trees support BOTH variants; only ripemd aggregation over
+already-hashed leaves (`root_from_hashes`) stays host-side.
 """
 
 from __future__ import annotations
@@ -36,9 +37,13 @@ class TreeHasher:
     ) -> None:
         if backend not in ("device", "host"):
             raise ValueError(f"unknown backend {backend!r}")
+        if algo not in ("sha256", "ripemd160"):
+            raise ValueError(f"unknown algo {algo!r}")
         self.algo = algo
-        # device tree reduction is sha256-only; ripemd160 stays on host
-        self.backend = backend if algo == "sha256" else "host"
+        # device trees support both variants: sha256 (the framework's
+        # target) and ripemd160 (the reference's bit-compat tree,
+        # `docs/specification/merkle.rst`)
+        self.backend = backend
         self.min_device_leaves = (
             DEVICE_MIN_LEAVES if min_device_leaves is None else min_device_leaves
         )
@@ -51,12 +56,14 @@ class TreeHasher:
         if self._use_device(len(items)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_device
 
-            return merkle_root_device(items)
+            return merkle_root_device(items, self.algo)
         return host_merkle.simple_hash_from_byte_slices(items, self.algo)
 
     def root_from_hashes(self, hashes: list[bytes]) -> bytes:
-        """Root over already-hashed leaves (PartSet/Commit aggregation)."""
-        if self._use_device(len(hashes)):
+        """Root over already-hashed leaves (PartSet/Commit aggregation).
+        Device path is sha256-only here (BE leaf-word ingest); ripemd
+        aggregation stays host-side."""
+        if self.algo == "sha256" and self._use_device(len(hashes)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_from_leaf_words
             from tendermint_tpu.ops.padding import digests_to_bytes_be
 

@@ -21,14 +21,23 @@ _enabled = False
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point JAX at the on-disk executable cache. Idempotent; returns the
-    cache dir or None when disabled via env."""
+    cache dir or None when disabled via env.
+
+    TENDERMINT_TPU_XLA_CACHE: off/0/false/no/disable disables; on/1/
+    true/yes (or unset) uses the default dir; anything else must look
+    like a path (contain a separator) and names the dir."""
     global _enabled
     env = os.environ.get("TENDERMINT_TPU_XLA_CACHE", "")
-    if env.lower() in ("off", "0", "disable"):
+    if env.lower() in ("off", "0", "disable", "false", "no"):
         return None
+    env_dir = env if env.lower() not in ("", "on", "1", "true", "yes") else ""
+    if env_dir and os.sep not in env_dir:
+        raise ValueError(
+            f"TENDERMINT_TPU_XLA_CACHE={env!r}: expected on/off or a directory path"
+        )
+    path = cache_dir or env_dir or _DEFAULT_DIR
     if _enabled:
-        return cache_dir or env or _DEFAULT_DIR
-    path = cache_dir or (env if env else _DEFAULT_DIR)
+        return path
     os.makedirs(path, exist_ok=True)
     import jax
 

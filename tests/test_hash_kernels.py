@@ -130,3 +130,25 @@ def test_65k_tx_block_data_hash_from_device_tree():
     assert block.header.data_hash == simple_hash_from_byte_slices(list(txs))
     # and the validation side accepts it through the same device path
     block.validate_basic(dev)
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16, 33, 100])
+def test_ripemd_merkle_tree_matches_host(n):
+    """Device RIPEMD-160 tree (the reference's bit-compat variant,
+    `docs/specification/merkle.rst:52-90`) vs the host tree."""
+    items = [f"rleaf-{i}".encode() * (i % 4 + 1) for i in range(n)]
+    assert merkle_root_device(items, "ripemd160") == simple_hash_from_byte_slices(
+        items, "ripemd160"
+    )
+
+
+def test_ripemd_forest_mixed_tree_sizes():
+    from tendermint_tpu.ops.merkle_kernel import merkle_roots_forest
+
+    trees = [
+        [b"a", b"bb", b"ccc"],
+        [f"r{i}".encode() * (i % 3 + 1) for i in range(9)],
+        [b"solo"],
+    ]
+    got = merkle_roots_forest(trees, "ripemd160")
+    assert got == [simple_hash_from_byte_slices(t, "ripemd160") for t in trees]

@@ -197,7 +197,9 @@ class TestRouting:
                 ek.verify_kernel(pub, r, s, h),
             )[1],
         )
-        monkeypatch.setattr(ek.jax, "default_backend", lambda: "tpu")
+        import jax as jax_mod
+
+        monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
         pubs, msgs, sigs = _batch(1000, corrupt={7})
         out = ek.batch_verify(pubs, msgs, sigs)
         assert calls == [1024]  # padded to the pallas bucket

@@ -72,11 +72,16 @@ class TestTreeHasher:
         assert TreeHasher("device", min_device_leaves=2).root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
         assert TreeHasher("host").root_from_hashes(hashes) == simple_hash_from_hashes(hashes)
 
-    def test_ripemd_falls_back_to_host(self):
-        th = TreeHasher("device", algo="ripemd160")
-        assert th.backend == "host"
-        items = [b"a", b"b", b"c"]
+    def test_ripemd_device_tree_matches_host(self):
+        # the reference's bit-compat tree variant now runs on device too
+        th = TreeHasher("device", algo="ripemd160", min_device_leaves=2)
+        items = [b"item-%d" % i for i in range(11)]
         assert th.root_from_items(items) == simple_hash_from_byte_slices(items, "ripemd160")
+        # already-hashed aggregation stays host-side for ripemd
+        from tendermint_tpu.merkle.simple import leaf_hash
+
+        hashes = [leaf_hash(b"h%d" % i, "ripemd160") for i in range(5)]
+        assert th.root_from_hashes(hashes) == simple_hash_from_hashes(hashes, "ripemd160")
 
     def test_edge_counts(self):
         th = TreeHasher("device", min_device_leaves=2)
