@@ -61,8 +61,11 @@ def inner_hash_device(L, R):
     return sha256_fixed2_from_words(b0, b1)
 
 
-_B8_LE = jnp.uint32(8)
-_B24_LE = jnp.uint32(24)
+# numpy scalars, NOT jnp: module-level jnp calls initialize the XLA
+# backend at import, which breaks jax.distributed.initialize for every
+# later importer (multi-host workers must init before any backend use)
+_B8_LE = np.uint32(8)
+_B24_LE = np.uint32(24)
 
 
 def _inner_node_words_ripemd(L, R):
@@ -70,7 +73,7 @@ def _inner_node_words_ripemd(L, R):
     for H(0x01 || L20 || R20) = 41 bytes (fits one RIPEMD-160 block:
     0x80 at byte 41, bit length 328 LE at words 14-15)."""
     b = L.shape[0]
-    w = [jnp.uint32(INNER_PREFIX[0]) | (L[:, 0] << _B8_LE)]
+    w = [np.uint32(INNER_PREFIX[0]) | (L[:, 0] << _B8_LE)]
     for i in range(1, 5):
         w.append((L[:, i - 1] >> _B24_LE) | (L[:, i] << _B8_LE))
     w.append((L[:, 4] >> _B24_LE) | (R[:, 0] << _B8_LE))
