@@ -125,24 +125,30 @@ def _forest_levels(nodes, cnt, levels: int, algo: str = "sha256"):
     return nodes[:, 0]
 
 
-@partial(jax.jit, static_argnames=("levels",))
-def _tree_reduce(leaves, count, levels: int):
-    """leaves: (P, 8) u32 with P = 2**levels; count: traced i32 valid prefix.
-    Returns (8,) root words. The T=1 case of `_forest_levels`."""
-    return _forest_levels(leaves[None], jnp.asarray(count)[None], levels)[0]
+@partial(jax.jit, static_argnames=("levels", "algo"))
+def _tree_reduce(leaves, count, levels: int, algo: str = "sha256"):
+    """leaves: (P, W) u32 with P = 2**levels; count: traced i32 valid prefix.
+    Returns (W,) root words. The T=1 case of `_forest_levels`."""
+    return _forest_levels(leaves[None], jnp.asarray(count)[None], levels, algo)[0]
 
 
-def merkle_root_from_leaf_words(leaf_digests, count=None):
+def merkle_root_from_leaf_words(leaf_digests, count=None, algo: str = "sha256"):
     """Root from device leaf hashes.
 
-    leaf_digests: (N, 8) u32 (already leaf-prefixed hashes). N is padded up to
-    the next power of two internally; `count` defaults to N.
+    leaf_digests: (N, W) u32 (already leaf-prefixed hashes; W = 8 for
+    sha256 BE words, 5 for ripemd160 LE words). N is padded up to the
+    next power of two internally; `count` defaults to N.
     """
+    width = _ALGOS[algo][0]
     leaf_digests = jnp.asarray(leaf_digests, dtype=jnp.uint32)
     n = leaf_digests.shape[0]
     if n == 0:
         raise ValueError(
             "empty leaf batch has no root (host simple_hash_from_hashes([]) is b'')"
+        )
+    if leaf_digests.shape[1] != width:
+        raise ValueError(
+            f"{algo} leaf digests must be (N, {width}) words, got {leaf_digests.shape}"
         )
     if count is None:
         count = n
@@ -150,10 +156,12 @@ def merkle_root_from_leaf_words(leaf_digests, count=None):
     while P < n:
         P *= 2
     if P != n:
-        pad = jnp.zeros((P - n, 8), dtype=jnp.uint32)
+        pad = jnp.zeros((P - n, width), dtype=jnp.uint32)
         leaf_digests = jnp.concatenate([leaf_digests, pad], axis=0)
     levels = P.bit_length() - 1
-    return _tree_reduce(leaf_digests, jnp.asarray(count, dtype=jnp.int32), levels)
+    return _tree_reduce(
+        leaf_digests, jnp.asarray(count, dtype=jnp.int32), levels, algo
+    )
 
 
 @partial(jax.jit, static_argnames=("max_blocks", "levels", "algo"))

@@ -8,8 +8,8 @@ host backend is the bit-identical sequential reference.
 
 The reference's tree uses RIPEMD-160 (`docs/specification/merkle.rst`);
 this framework's target variant is SHA-256 (BASELINE.md north star).
-Device trees support BOTH variants; only ripemd aggregation over
-already-hashed leaves (`root_from_hashes`) stays host-side.
+Device trees support BOTH variants (raw-leaf builds and
+already-hashed-leaf aggregation).
 """
 
 from __future__ import annotations
@@ -60,18 +60,25 @@ class TreeHasher:
         return host_merkle.simple_hash_from_byte_slices(items, self.algo)
 
     def root_from_hashes(self, hashes: list[bytes]) -> bytes:
-        """Root over already-hashed leaves (PartSet/Commit aggregation).
-        Device path is sha256-only here (BE leaf-word ingest); ripemd
-        aggregation stays host-side."""
-        if self.algo == "sha256" and self._use_device(len(hashes)):
+        """Root over already-hashed leaves (PartSet/Commit aggregation)."""
+        if self._use_device(len(hashes)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_from_leaf_words
-            from tendermint_tpu.ops.padding import digests_to_bytes_be
-
-            words = np.stack(
-                [np.frombuffer(h, dtype=">u4").astype(np.uint32) for h in hashes]
+            from tendermint_tpu.ops.padding import (
+                digests_to_bytes_be,
+                digests_to_bytes_le,
             )
-            root = merkle_root_from_leaf_words(words)
-            return digests_to_bytes_be(np.asarray(root)[None, :])[0]
+
+            # sha256 digests are big-endian words; ripemd160 little-endian
+            dt, to_bytes = (
+                (">u4", digests_to_bytes_be)
+                if self.algo == "sha256"
+                else ("<u4", digests_to_bytes_le)
+            )
+            words = np.stack(
+                [np.frombuffer(h, dtype=dt).astype(np.uint32) for h in hashes]
+            )
+            root = merkle_root_from_leaf_words(words, algo=self.algo)
+            return to_bytes(np.asarray(root)[None, :])[0]
         return host_merkle.simple_hash_from_hashes(hashes, self.algo)
 
     def proofs(self, items: list[bytes]):

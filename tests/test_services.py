@@ -77,7 +77,7 @@ class TestTreeHasher:
         th = TreeHasher("device", algo="ripemd160", min_device_leaves=2)
         items = [b"item-%d" % i for i in range(11)]
         assert th.root_from_items(items) == simple_hash_from_byte_slices(items, "ripemd160")
-        # already-hashed aggregation stays host-side for ripemd
+        # already-hashed aggregation rides the device tree too
         from tendermint_tpu.merkle.simple import leaf_hash
 
         hashes = [leaf_hash(b"h%d" % i, "ripemd160") for i in range(5)]
