@@ -407,21 +407,33 @@ def build_key_tables(pub_bytes: np.ndarray, chunk: int = 2048):
     memory (each chunk materializes chunk*1024 extended points).
 
     pub_bytes: (N, 32) uint8. Returns (tables (64, 16, 60, N) int16 on
-    device — window, digit, limb, validator — and ok (N,) bool on host)."""
+    device — window, digit, limb, validator — and ok (N,) bool on host).
+
+    On TPU every chunk pads to the FULL chunk size so all builds of any
+    N share ONE compiled executable — a fresh pow2 shape would pay its
+    own ~20 s per-process program upload (docs/PLATFORM_NOTES.md), while
+    the extra pad columns cost <1 s of device work. Off-TPU (tests) the
+    pad stays at the next power of two."""
     n = pub_bytes.shape[0]
+    on_tpu = jax.default_backend() == "tpu"
     tbls, oks = [], []
     for lo in range(0, n, chunk):
         part = np.asarray(pub_bytes[lo : lo + chunk], dtype=np.uint8)
         m = part.shape[0]
-        padded = 1
-        while padded < m:
-            padded *= 2
+        if on_tpu:
+            padded = chunk
+        else:
+            padded = 1
+            while padded < m:
+                padded *= 2
         if padded != m:
             part = np.concatenate(
                 [part, np.tile(_IDENT_PUB, (padded - m, 1))], axis=0
             )
         t, ok = _build_tables_kernel(jnp.asarray(part))
-        tbls.append(_to_fused_layout(t[:, :m]))
+        # layout-convert at the padded shape, slice after: slicing first
+        # would give _to_fused_layout a fresh executable per residual m
+        tbls.append(_to_fused_layout(t)[..., :m])
         oks.append(np.asarray(ok)[:m])
     return jnp.concatenate(tbls, axis=3), np.concatenate(oks)
 

@@ -74,8 +74,10 @@ class TreeHasher:
                 if self.algo == "sha256"
                 else ("<u4", digests_to_bytes_le)
             )
-            words = np.stack(
-                [np.frombuffer(h, dtype=dt).astype(np.uint32) for h in hashes]
+            words = (
+                np.frombuffer(b"".join(hashes), dtype=dt)
+                .astype(np.uint32)
+                .reshape(len(hashes), -1)
             )
             root = merkle_root_from_leaf_words(words, algo=self.algo)
             return to_bytes(np.asarray(root)[None, :])[0]

@@ -410,6 +410,9 @@ class TestBulkTurnover:
         import tendermint_tpu.ops.ed25519_tables as tbl_mod
 
         def fake_device_build(pub_arr, chunk=2048):
+            # chunk-shape padding happens INSIDE build_key_tables (one
+            # executable for all TPU builds), so the seam receives the
+            # raw missing keys
             device_builds.append(pub_arr.shape[0])
             t, okk = host_build_key_tables([bytes(row) for row in pub_arr])
             return jnp.asarray(t), okk
