@@ -38,9 +38,15 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     path = cache_dir or env_dir or _DEFAULT_DIR
     if _enabled:
         return path
-    os.makedirs(path, exist_ok=True)
     import jax
 
+    # TPU executables only: XLA:CPU AOT results bake in host machine
+    # features and warn "could lead to SIGILL" when loaded on a host
+    # whose feature detection differs (observed with the axon stack) —
+    # and CPU compiles are cheap enough not to need the cache
+    if jax.default_backend() != "tpu":
+        return None
+    os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     # every kernel here is worth caching: even "fast" compiles are tens
     # of launch floors on this device
