@@ -116,6 +116,16 @@ class Config:
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.pex_ensure_interval_s = 0.5
+        try:
+            import cryptography  # noqa: F401
+        except ImportError:
+            # X25519/AEAD transport crypto deliberately has NO pure-Python
+            # fallback (unlike signing, `crypto/ed25519_ref.py`); in test
+            # environments without the library, run links unencrypted so
+            # the p2p/node matrices still exercise everything else.
+            # Production configs keep secret_connections=True and fail
+            # loudly if the library is missing.
+            cfg.p2p.secret_connections = False
         return cfg
 
 
@@ -145,16 +155,61 @@ def write_config(cfg: Config) -> str:
     return path
 
 
+def _parse_toml_subset(text: str) -> dict:
+    """Parse exactly the dialect `write_config` emits ([section] headers,
+    `key = true|false|int|float|"escaped string"` lines, # comments) —
+    the stdlib `tomllib` only exists on Python 3.11+, and a node must
+    still boot its own config files on 3.10 hosts."""
+    doc: dict = {}
+    section: dict | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = doc.setdefault(line[1:-1].strip(), {})
+            continue
+        if section is None or "=" not in line:
+            continue
+        key, _, val = (p.strip() for p in line.partition("="))
+        if val.startswith('"') and val.endswith('"') and len(val) >= 2:
+            body = val[1:-1]
+            out, i = [], 0
+            while i < len(body):
+                if body[i] == "\\" and i + 1 < len(body):
+                    out.append(body[i + 1])
+                    i += 2
+                else:
+                    out.append(body[i])
+                    i += 1
+            section[key] = "".join(out)
+        elif val in ("true", "false"):
+            section[key] = val == "true"
+        else:
+            try:
+                section[key] = int(val)
+            except ValueError:
+                try:
+                    section[key] = float(val)
+                except ValueError:
+                    continue  # not our dialect: ignore the line
+    return doc
+
+
 def load_config(home: str) -> Config:
     """Defaults overlaid with `$home/config.toml` when present."""
-    import tomllib
-
     cfg = Config.default(home)
     path = os.path.join(home, "config.toml")
     if not os.path.exists(path):
         return cfg
-    with open(path, "rb") as fh:
-        doc = tomllib.load(fh)
+    try:
+        import tomllib  # Python 3.11+
+
+        with open(path, "rb") as fh:
+            doc = tomllib.load(fh)
+    except ImportError:
+        with open(path, "r") as fh:
+            doc = _parse_toml_subset(fh.read())
     for section in _SECTIONS:
         sub = getattr(cfg, section)
         for f in fields(sub):

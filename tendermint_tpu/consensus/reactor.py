@@ -942,6 +942,13 @@ class ConsensusReactor(Reactor):
             if cur == last_hrs:
                 ps.clear_height_bits(prs.height)
             last_hrs = cur
+            # Re-announce our own step every tick: a NewRoundStep lost
+            # on a lossy link (or swallowed by a partition) leaves the
+            # peer's mirror of US stale, which disables every gossip
+            # path toward us — we cannot detect that from our side, so
+            # the retry must be unconditional. 20 idempotent bytes per
+            # 0.5 s per peer buys partition-heal and late-join liveness.
+            peer.try_send(STATE_CHANNEL, self._our_step_message().encode())
             if rs.votes is None or rs.height != prs.height:
                 continue
             for round_ in (rs.round, prs.round, prs.proposal_pol_round):

@@ -254,12 +254,15 @@ class ConsensusState:
                         stashed = nxt
                         break
             try:
-                if batch is not None:
-                    # any drained run goes through the batch path: below
-                    # VOTE_DRAIN_MIN the preverify routes to host anyway,
-                    # and per-vote fault isolation must hold either way
+                if batch is not None and len(batch) >= self.VOTE_DRAIN_MIN:
+                    # per-vote fault isolation must hold on this path too
                     # (one equivocating vote must not drop its siblings)
                     self._process_vote_batch(batch)
+                elif batch is not None:
+                    # runs too small to amortize a batch preverify take
+                    # the single-vote path, in drain order
+                    for rec in batch:
+                        self._process_item(rec)
                 else:
                     self._process_item(item)
             except (ErrDoubleSign, FatalConsensusError) as e:

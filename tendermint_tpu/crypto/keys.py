@@ -3,8 +3,12 @@
 Fills the slot of go-crypto's `PrivKeyEd25519`/`PubKeyEd25519`/`Signature`
 (reference call sites: `types/priv_validator.go:92` signing,
 `types/vote_set.go:177` and `types/validator_set.go:253` verification).
-Host path wraps the `cryptography` library; the batched device path lives in
-`tendermint_tpu.ops.ed25519` and is cross-validated against this one.
+Host path wraps the `cryptography` library when available and degrades
+to the pure-Python RFC 8032 backend (`crypto/ed25519_ref.py`) when that
+import fails — same shape as the device→host dispatch in
+`services/resilient.py`. The batched device path lives in
+`tendermint_tpu.ops.ed25519_kernel` and is cross-validated against this
+one.
 """
 
 from __future__ import annotations
@@ -12,12 +16,17 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pure-Python fallback backend
+    HAVE_CRYPTOGRAPHY = False
 
 from tendermint_tpu.crypto.hashing import address_hash
 
@@ -40,6 +49,10 @@ class PubKey:
         """One-at-a-time host verification (the slow reference path)."""
         if len(signature) != SIGNATURE_LEN:
             return False
+        if not HAVE_CRYPTOGRAPHY:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            return ed25519_ref.verify(self.data, msg, signature)
         try:
             Ed25519PublicKey.from_public_bytes(self.data).verify(signature, msg)
             return True
@@ -69,14 +82,22 @@ class PrivKey:
         if len(self.seed) != PRIVKEY_SEED_LEN:
             raise ValueError(f"privkey seed must be {PRIVKEY_SEED_LEN} bytes")
 
-    def _key(self) -> Ed25519PrivateKey:
+    def _key(self) -> "Ed25519PrivateKey":
         return Ed25519PrivateKey.from_private_bytes(self.seed)
 
     def sign(self, msg: bytes) -> bytes:
+        if not HAVE_CRYPTOGRAPHY:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            return ed25519_ref.sign(self.seed, msg)
         return self._key().sign(msg)
 
     @property
     def pub_key(self) -> PubKey:
+        if not HAVE_CRYPTOGRAPHY:
+            from tendermint_tpu.crypto import ed25519_ref
+
+            return PubKey(ed25519_ref.public_from_seed(self.seed))
         raw = self._key().public_key().public_bytes(
             serialization.Encoding.Raw, serialization.PublicFormat.Raw
         )

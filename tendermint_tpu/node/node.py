@@ -320,30 +320,62 @@ class Node:
 
     # -- persistent peers ---------------------------------------------------
 
+    @staticmethod
+    def _split_persistent_addr(addr: str) -> tuple[str | None, str]:
+        """`id@host:port` -> (expected node_id, dialable addr); plain
+        `host:port` -> (None, addr). The id form is the reference's
+        persistent_peers syntax and pins reconnects to a transport
+        identity instead of a (possibly NAT-shared) host."""
+        head, sep, rest = addr.partition("@")
+        if sep and head and "://" not in head and ":" not in head:
+            return head, rest
+        return None, addr
+
     def _on_peer_removed(self, peer, reason) -> None:
         """Heal dropped persistent links (reference `p2p/switch.go:290-320`)."""
         addr = self._peer_addr.pop(peer.id, None)
-        if addr is None and peer.node_info.listen_addr in self._persistent_addrs:
-            # inbound persistent peer (they dialed us): still ours to heal
-            addr = peer.node_info.listen_addr
+        if addr is None:
+            for cand in self._persistent_addrs:
+                expected_id, dial_addr = self._split_persistent_addr(cand)
+                if expected_id == peer.id or (
+                    expected_id is None
+                    and peer.node_info.listen_addr == dial_addr
+                ):
+                    # inbound persistent peer (they dialed us): ours to heal
+                    addr = cand
+                    break
         if addr is None or not self._p2p_running:
             return
         self._spawn_persistent_dial(addr)
 
     def _adopt_inbound_persistent(self, addr: str) -> None:
         """Map an already-connected peer to its persistent address so a
-        later drop gets redialed (they-dialed-first / race cases). Matched
-        by advertised listen_addr or socket host; a hostname that resolves
-        differently from the peer's reported address stays unmatched — the
-        listen_addr fallback in _on_peer_removed is the remaining net."""
-        host = addr.split("://")[-1].rsplit(":", 1)[0]
-        for p in self.switch.peers():
-            if p.id in self._peer_addr:
-                continue
-            sock_host = p.remote_addr.rsplit(":", 1)[0] if p.remote_addr else ""
-            if p.node_info.listen_addr == addr or (sock_host and sock_host == host):
+        later drop gets redialed (they-dialed-first / race cases).
+        Match precedence: pinned node_id (`id@host:port` form), then
+        advertised listen_addr, then socket host — but the bare host
+        match only when it is unambiguous (exactly one unmapped
+        candidate), since several NAT'd peers can share one IP and a
+        wrong mapping makes a later drop redial the wrong address."""
+        expected_id, dial_addr = self._split_persistent_addr(addr)
+        candidates = [p for p in self.switch.peers() if p.id not in self._peer_addr]
+        if expected_id is not None:
+            for p in candidates:
+                if p.id == expected_id:
+                    self._peer_addr[p.id] = addr
+                    return
+            return  # pinned id not connected: nothing safe to adopt
+        for p in candidates:
+            if p.node_info.listen_addr == dial_addr:
                 self._peer_addr[p.id] = addr
                 return
+        host = dial_addr.split("://")[-1].rsplit(":", 1)[0]
+        by_host = [
+            p
+            for p in candidates
+            if p.remote_addr and p.remote_addr.rsplit(":", 1)[0] == host
+        ]
+        if len(by_host) == 1:
+            self._peer_addr[by_host[0].id] = addr
 
     def _spawn_persistent_dial(self, addr: str) -> None:
         with self._persistent_lock:
@@ -365,12 +397,19 @@ class Node:
 
         cfg = self.config.p2p
         log = logging.getLogger(__name__)
+        expected_id, dial_addr = self._split_persistent_addr(addr)
         try:
             for attempt in range(max(1, cfg.reconnect_max_attempts)):
                 if not self._p2p_running:
                     return
                 try:
-                    peer = dial(self.switch, addr, priv_key=self._node_key)
+                    peer = dial(self.switch, dial_addr, priv_key=self._node_key)
+                    if expected_id is not None and peer.id != expected_id:
+                        self.switch.stop_peer(peer, "persistent peer id mismatch")
+                        raise ConnectionError(
+                            f"dialed {dial_addr}: got id {peer.id[:12]}, "
+                            f"want {expected_id[:12]}"
+                        )
                     self._peer_addr[peer.id] = addr
                     # the peer may have died between registration and the
                     # mapping write above — then _on_peer_removed already

@@ -89,6 +89,7 @@ class MConnection:
         self._on_error = on_error
         self._send_wake = threading.Event()
         self._running = False
+        self._stopped = False  # terminal: refuses sends; pre-start queues them
         self._threads: list[threading.Thread] = []
         self._err_once = threading.Event()
         self.ping_interval = ping_interval
@@ -109,6 +110,7 @@ class MConnection:
 
     def stop(self) -> None:
         self._running = False
+        self._stopped = True
         self._ping_stop.set()
         self._endpoint.close()
         self._send_wake.set()
@@ -117,11 +119,13 @@ class MConnection:
 
     def send(self, chan_id: int, payload: bytes, timeout: float = 5.0) -> bool:
         """Queue for send; blocks up to timeout on a full channel queue
-        (reference `Send` blocks, `TrySend` doesn't)."""
+        (reference `Send` blocks, `TrySend` doesn't). Sends BEFORE
+        start() queue up and flush once the send loop runs — reactors
+        greet a new peer (add_peer step messages) before it starts."""
         ch = self._channels.get(chan_id)
         if ch is None:
             raise ValueError(f"unknown channel {chan_id:#x}")
-        if not self._running:
+        if self._stopped:
             return False
         try:
             ch.queue.put(payload, timeout=timeout)
@@ -134,7 +138,7 @@ class MConnection:
         ch = self._channels.get(chan_id)
         if ch is None:
             raise ValueError(f"unknown channel {chan_id:#x}")
-        if not self._running:
+        if self._stopped:
             return False
         try:
             ch.queue.put_nowait(payload)
@@ -248,6 +252,7 @@ class MConnection:
             return
         self._err_once.set()
         self._running = False
+        self._stopped = True
         self._ping_stop.set()
         self._endpoint.close()
         if self._on_error is not None:

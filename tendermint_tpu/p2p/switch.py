@@ -167,6 +167,13 @@ class Switch:
                 pong_timeout=self.pong_timeout,
             )
             self._peers[remote_info.node_id] = peer
+        # Reactors install their per-peer state BEFORE the recv loop
+        # starts: a frame dispatched in the gap would find no PeerState
+        # and be silently dropped — at genesis a dropped NewRoundStep
+        # wedged vote gossip for good (found by the nemesis harness).
+        # Outbound sends queue in the channel buffers until start().
+        for r in self._reactors.values():
+            r.add_peer(peer)
         peer.start()
         kv(
             logger("p2p"),
@@ -176,8 +183,6 @@ class Switch:
             id=remote_info.node_id[:12],
             outbound=outbound,
         )
-        for r in self._reactors.values():
-            r.add_peer(peer)
         return peer
 
     def stop_peer(self, peer: Peer, reason) -> None:
@@ -223,10 +228,17 @@ class Switch:
             p.try_send(chan_id, payload)
 
 
-def connect_switches(a: Switch, b: Switch) -> tuple[Peer, Peer]:
+def connect_switches(a: Switch, b: Switch, wrap=None) -> tuple[Peer, Peer]:
     """Wire two switches over an in-memory pipe (reference
-    `Connect2Switches p2p/switch.go:526-534`)."""
+    `Connect2Switches p2p/switch.go:526-534`).
+
+    `wrap`, when given, maps the two raw endpoints to (possibly
+    fault-injecting) replacements before the peers attach — the seam
+    chaos drivers (`testing/nemesis.py`) use to own a link's faults:
+    `wrap(endpoint_a, endpoint_b) -> (endpoint_a', endpoint_b')`."""
     ea, eb = pipe_pair()
+    if wrap is not None:
+        ea, eb = wrap(ea, eb)
     pa = a.add_peer_endpoint(b.node_info, ea, outbound=True)
     pb = b.add_peer_endpoint(a.node_info, eb, outbound=False)
     return pa, pb

@@ -99,6 +99,7 @@ class FuzzConfig:
     prob_drop_conn: float = 0.0  # kill the link on a send
     prob_sleep: float = 0.0  # delay a send
     max_sleep_s: float = 0.05
+    prob_dup: float = 0.0  # deliver a send twice (gossip must be idempotent)
     seed: int | None = None
 
 
@@ -120,7 +121,77 @@ class FuzzedEndpoint:
             return True  # silently dropped
         if c.prob_sleep and self._rng.random() < c.prob_sleep:
             time.sleep(self._rng.uniform(0, c.max_sleep_s))
+        if c.prob_dup and self._rng.random() < c.prob_dup:
+            self._inner.send(data, timeout)
         return self._inner.send(data, timeout)
+
+    def recv(self, timeout: float | None = None) -> bytes:
+        return self._inner.recv(timeout)
+
+    def close(self) -> None:
+        self._inner.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._inner.closed
+
+
+class LinkChaos:
+    """Runtime-mutable fault knobs for ONE direction of a link.
+
+    Unlike FuzzConfig (fixed probabilities for a connection's lifetime)
+    these are flipped live by a chaos driver (`testing/nemesis.py`):
+    partition a running network, heal it, add delay or duplication for
+    a window, all without touching the peers' connection state.
+    """
+
+    def __init__(self, seed: int | None = None) -> None:
+        self.partitioned = False  # black-hole every send (partition)
+        self.delay_s = 0.0  # defer each delivery by this much
+        self.dup_prob = 0.0  # deliver twice
+        self.drop_prob = 0.0  # drop individual sends
+        self._rng = random.Random(seed)
+
+
+class ChaosEndpoint:
+    """Endpoint wrapper governed by a live LinkChaos.
+
+    Partitioned links swallow sends silently (a partition loses
+    packets; it does not error — the consensus gossip layer must treat
+    silence and loss identically). Delayed deliveries ride a timer
+    thread, so delay also implies possible reordering, exactly like a
+    real congested path. Composes over FuzzedEndpoint for probabilistic
+    background faults plus driver-controlled chaos on one link.
+    """
+
+    def __init__(self, inner, chaos: LinkChaos) -> None:
+        self._inner = inner
+        self.chaos = chaos
+
+    def send(self, data: bytes, timeout: float = 10.0) -> bool:
+        c = self.chaos
+        if c.partitioned:
+            return True  # black hole
+        if c.drop_prob and c._rng.random() < c.drop_prob:
+            return True
+        copies = 2 if (c.dup_prob and c._rng.random() < c.dup_prob) else 1
+        if c.delay_s > 0:
+            for _ in range(copies):
+                t = threading.Timer(c.delay_s, self._late_send, args=(data,))
+                t.daemon = True
+                t.start()
+            return True
+        ok = True
+        for _ in range(copies):
+            ok = self._inner.send(data, timeout)
+        return ok
+
+    def _late_send(self, data: bytes) -> None:
+        try:
+            if not self.chaos.partitioned:  # partition may have started
+                self._inner.send(data, timeout=1.0)
+        except EndpointClosed:
+            pass
 
     def recv(self, timeout: float | None = None) -> bytes:
         return self._inner.recv(timeout)

@@ -250,9 +250,24 @@ class WSClient:
 
     def events(self, timeout: float = 30.0):
         """Yield event notification params until timeout/close. A dead
-        connection triggers transparent reconnect + resubscribe; the
-        iterator only ends on a quiet-period timeout, explicit close, or
-        reconnect exhaustion."""
+        connection triggers transparent reconnect + resubscribe. The
+        iterator ends cleanly only on a quiet-period timeout or explicit
+        close(); reconnect exhaustion raises RPCClientError so callers
+        can tell "no events" from "connection permanently lost"."""
+
+        def _recovered() -> bool:
+            """True when reconnected, False on explicit close; raises on
+            reconnect exhaustion of a live client."""
+            if self._try_reconnect():
+                return True
+            if self._closed:
+                return False
+            raise RPCClientError(
+                -32000,
+                f"websocket connection lost and not recovered after "
+                f"{self._max_reconnect_attempts} reconnect attempts",
+            )
+
         while self._pending_events:
             yield self._pending_events.pop(0)
         while True:
@@ -261,7 +276,7 @@ class WSClient:
             except TimeoutError:
                 return  # no events within `timeout`: normal iterator end
             except OSError:
-                if not self._try_reconnect():
+                if not _recovered():
                     return
                 # resubscribe may have buffered events that raced the
                 # subscribe responses — deliver them in order now
@@ -269,7 +284,7 @@ class WSClient:
                     yield self._pending_events.pop(0)
                 continue
             if msg is None:  # server closed the stream
-                if not self._try_reconnect():
+                if not _recovered():
                     return
                 while self._pending_events:
                     yield self._pending_events.pop(0)

@@ -6,6 +6,10 @@ replacing one-at-a-time calls with an accumulate→flush batching contract.
 """
 
 from tendermint_tpu.services.hasher import TreeHasher
+from tendermint_tpu.services.resilient import (
+    ResilientTreeHasher,
+    ResilientVerifier,
+)
 from tendermint_tpu.services.verifier import (
     BatchVerifier,
     DeviceBatchVerifier,
@@ -18,6 +22,8 @@ __all__ = [
     "BatchVerifier",
     "DeviceBatchVerifier",
     "HostBatchVerifier",
+    "ResilientTreeHasher",
+    "ResilientVerifier",
     "TableBatchVerifier",
     "TreeHasher",
     "default_verifier",

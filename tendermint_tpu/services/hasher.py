@@ -94,7 +94,9 @@ _DEFAULT: TreeHasher | None = None
 def default_hasher() -> TreeHasher:
     global _DEFAULT
     if _DEFAULT is None:
-        _DEFAULT = TreeHasher()
+        from tendermint_tpu.services.resilient import ResilientTreeHasher
+
+        _DEFAULT = ResilientTreeHasher(TreeHasher())
     return _DEFAULT
 
 
@@ -104,8 +106,25 @@ def auto_hasher() -> TreeHasher:
     The node composition root calls this once at start so block production
     (`types/tx.go:33-46` analog) rides the device tree on TPU while CPU-only
     runs (tests, dev) never pay an XLA compile for host-sized work.
+
+    Device trees come wrapped in `ResilientTreeHasher` — a device fault
+    degrades block hashing to host hashlib behind a circuit breaker
+    instead of failing block production (`services/resilient.py`). Host
+    runs get the wrapper too when fault injection is armed, so chaos
+    tests drive the same dispatch path on CPU CI.
     """
     import jax
 
-    backend = "device" if jax.default_backend() == "tpu" else "host"
-    return TreeHasher(backend=backend)
+    from tendermint_tpu.utils.fail import device_faults_armed
+
+    if jax.default_backend() == "tpu":
+        from tendermint_tpu.services.resilient import ResilientTreeHasher
+
+        return ResilientTreeHasher(TreeHasher(backend="device"))
+    if device_faults_armed():
+        from tendermint_tpu.services.resilient import ResilientTreeHasher
+
+        return ResilientTreeHasher(
+            TreeHasher(backend="device"), TreeHasher(backend="host")
+        )
+    return TreeHasher(backend="host")

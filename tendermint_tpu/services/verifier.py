@@ -346,15 +346,30 @@ def default_verifier() -> BatchVerifier:
     host crypto library, so fall back to HostBatchVerifier there.
     Consensus paths that don't thread an explicit verifier use this
     (mirrors the reference's package-global crypto functions).
+
+    Device backends are wrapped in `ResilientVerifier` so a device
+    fault degrades verification to host instead of killing consensus
+    (`services/resilient.py`); host-only runs get the wrapper too when
+    fault injection / TENDERMINT_TPU_RESILIENT is armed, so chaos tests
+    exercise the same dispatch path CI-side.
     """
     global _DEFAULT
     if _DEFAULT is None:
         import jax
 
+        from tendermint_tpu.utils.fail import device_faults_armed
+
         if jax.default_backend() == "cpu":
-            _DEFAULT = HostBatchVerifier()
+            if device_faults_armed():
+                from tendermint_tpu.services.resilient import ResilientVerifier
+
+                _DEFAULT = ResilientVerifier(DeviceBatchVerifier())
+            else:
+                _DEFAULT = HostBatchVerifier()
         else:
-            _DEFAULT = TableBatchVerifier()
+            from tendermint_tpu.services.resilient import ResilientVerifier
+
+            _DEFAULT = ResilientVerifier(TableBatchVerifier())
     return _DEFAULT
 
 

@@ -1,0 +1,9 @@
+"""In-process chaos testing harnesses (network nemesis + invariants)."""
+
+from tendermint_tpu.testing.nemesis import (
+    InvariantViolation,
+    Nemesis,
+    NemesisNode,
+)
+
+__all__ = ["InvariantViolation", "Nemesis", "NemesisNode"]

@@ -1,0 +1,497 @@
+"""Nemesis: a chaos driver for multi-node in-process consensus networks.
+
+Runs N full consensus nodes (ConsensusState + reactor + Switch, the
+`tests/test_reactor.py` topology promoted to a reusable harness) in one
+process and attacks them while INVARIANT CHECKERS run continuously:
+
+* **no-fork** — every height stored by 2+ nodes has exactly one block
+  hash across all block stores;
+* **commit agreement** — each node's seen-commit for a height certifies
+  the block it stored at that height;
+* **eventual progress** — after faults clear, the network keeps
+  committing (asserted by `wait_height` / `wait_progress`).
+
+Fault primitives compose (Jepsen-nemesis style, hence the name):
+
+* `partition(groups)` / `heal()` — switch-level link black-holing via
+  runtime `LinkChaos` flags (`p2p/transport.py`); new links inherit the
+  live partition, so a restarting node cannot tunnel across it;
+* `delay(i, j, s)` / `duplicate(i, j, p)` — per-link latency and
+  duplicate delivery (delayed sends may reorder, like a real path);
+* `FuzzConfig` — probabilistic background faults on every link
+  (reference `p2p/fuzz.go`), composed under the chaos wrapper;
+* `crash(i)` / `restart(i)` — stop a node abruptly and rebuild it from
+  its surviving stores + WAL (crash recovery is the code under test,
+  not a harness feature); `crash_at_fail_point(idx)` arms the existing
+  `FAIL_TEST_INDEX` machinery in soft mode so the node's consensus
+  thread dies mid-persistence-step, in process;
+* `truncate_wal_tail(i)` / `corrupt_wal_tail(i)` — damage the crashed
+  node's WAL the way a torn write would, before restarting it;
+* device fault injection (`utils/fail.py` TENDERMINT_TPU_DEVICE_FAIL /
+  `set_device_fault`) — trips the resilient-dispatch circuit breaker
+  (`services/resilient.py`) mid-height; the invariants then prove the
+  host-fallback keeps both safety AND liveness.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from tendermint_tpu.p2p.peer import NodeInfo
+from tendermint_tpu.p2p.switch import Switch, connect_switches
+from tendermint_tpu.p2p.transport import (
+    ChaosEndpoint,
+    FuzzConfig,
+    FuzzedEndpoint,
+    LinkChaos,
+)
+from tendermint_tpu.utils.log import kv, logger
+import logging
+
+_log = logger("nemesis")
+
+
+class InvariantViolation(AssertionError):
+    """A safety invariant broke under chaos — the bug this harness hunts."""
+
+
+def make_genesis(n_vals: int, chain_id: str):
+    """Deterministic genesis + index-aligned priv validators (the
+    `tests/helpers.py` fixture shape, owned here so the harness is
+    importable outside the test tree)."""
+    from tendermint_tpu.crypto import PrivKey
+    from tendermint_tpu.types import PrivValidator, Validator, ValidatorSet
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    privs = [
+        PrivValidator(PrivKey(i.to_bytes(32, "little")))
+        for i in range(1, n_vals + 1)
+    ]
+    vs = ValidatorSet(
+        [
+            Validator(address=p.address, pub_key=p.pub_key, voting_power=10)
+            for p in privs
+        ]
+    )
+    by_addr = {p.address: p for p in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(pub_key=v.pub_key, power=v.voting_power)
+            for v in vs.validators
+        ],
+    )
+    return genesis, ordered
+
+
+class NemesisNode:
+    """One rebuildable in-process node: durable stores + disposable
+    runtime (consensus state, reactor, switch are rebuilt on restart;
+    state DB, block store DB, app instance, and the on-disk WAL
+    survive, exactly the crash-recovery contract of a real node)."""
+
+    def __init__(
+        self,
+        index: int,
+        genesis,
+        privs,
+        home: str,
+        chain_id: str,
+        config=None,
+        verifier=None,
+        hasher=None,
+    ) -> None:
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.db.kv import MemDB
+        from tendermint_tpu.state import make_genesis_state
+
+        self.index = index
+        self.chain_id = chain_id
+        self.genesis = genesis
+        self.priv_validator = privs[index] if index < len(privs) else None
+        self.config = config or self.default_config()
+        self.verifier = verifier
+        self.hasher = hasher
+        self.state_db = MemDB()
+        self.store_db = MemDB()
+        # app-side persistence is the app's concern (the reference
+        # Handshaker replays it back in sync); modeling a durable app
+        # keeps the harness focused on consensus-side recovery
+        self.app = KVStoreApp()
+        self.wal_path = os.path.join(home, f"node{index}", "cs.wal")
+        os.makedirs(os.path.dirname(self.wal_path), exist_ok=True)
+        state = make_genesis_state(self.state_db, genesis)
+        state.save()
+        self.running = False
+        self._build()
+
+    @staticmethod
+    def default_config():
+        """test_config timeouts, but PACED commits: at full test speed
+        (skip_timeout_commit, 10 ms) a healthy 4-node chain commits
+        ~50 heights/s — faster than one-height-at-a-time consensus
+        catchup can ever walk, so a partitioned/restarted node would
+        never rejoin a long-running net. ~4 heights/s leaves catchup
+        (and CI machines under load) decisive headroom."""
+        from tendermint_tpu.consensus.config import ConsensusConfig
+
+        cfg = ConsensusConfig.test_config()
+        cfg.timeout_commit = 250
+        cfg.skip_timeout_commit = False
+        return cfg
+
+    def _build(self) -> None:
+        from tendermint_tpu.abci.client import local_client_creator
+        from tendermint_tpu.blockchain.store import BlockStore
+        from tendermint_tpu.consensus.reactor import ConsensusReactor
+        from tendermint_tpu.consensus.state import ConsensusState
+        from tendermint_tpu.consensus.ticker import TimeoutTicker
+        from tendermint_tpu.state.state import load_state
+
+        state = load_state(self.state_db)
+        self.store = BlockStore(self.store_db)
+        self.conns = local_client_creator(self.app)()
+        self.cs = ConsensusState(
+            config=self.config,
+            state=state,
+            app_conn=self.conns.consensus,
+            block_store=self.store,
+            priv_validator=self.priv_validator,
+            wal_path=self.wal_path,
+            ticker=TimeoutTicker(),
+            verifier=self.verifier,
+            hasher=self.hasher,
+        )
+        self.reactor = ConsensusReactor(self.cs)
+        self.switch = Switch(
+            NodeInfo(
+                node_id=f"node{self.index}",
+                moniker=f"nemesis{self.index}",
+                chain_id=self.chain_id,
+            )
+        )
+        self.switch.add_reactor("consensus", self.reactor)
+
+    def start(self) -> None:
+        self.switch.start()  # reactor.on_start starts the consensus loop
+        self.running = True
+
+    def stop(self) -> None:
+        if self.running:
+            self.switch.stop()
+            self.running = False
+
+    def crash(self) -> None:
+        """Abrupt teardown: peers cut, loop stopped, WAL left exactly as
+        the last fsync'd record (no clean end-of-height marker is
+        written — ConsensusState only marks committed heights, so the
+        tail is whatever the 'crash' interrupted)."""
+        self.stop()
+
+    def restart(self) -> None:
+        """Rebuild from surviving stores; `_catchup_replay` replays the
+        WAL tail for the in-progress height before the loop starts."""
+        if self.running:
+            raise RuntimeError(f"node{self.index} is running; crash() first")
+        self._build()
+        self.start()
+
+    @property
+    def height(self) -> int:
+        return self.cs.height
+
+
+class Nemesis:
+    """N-node in-process network + fault primitives + live invariants.
+
+    Use as a context manager: `with Nemesis(4, home=tmp) as net: ...` —
+    exit stops everything and re-raises any invariant violation the
+    background monitor recorded.
+    """
+
+    def __init__(
+        self,
+        n_nodes: int,
+        n_vals: int | None = None,
+        home: str | None = None,
+        config=None,
+        fuzz: FuzzConfig | None = None,
+        chain_id: str = "nemesis-chain",
+        verifier_factory=None,
+        hasher_factory=None,
+        monitor_interval_s: float = 0.25,
+    ) -> None:
+        import tempfile
+
+        self.chain_id = chain_id
+        self.home = home or tempfile.mkdtemp(prefix="nemesis-")
+        self.fuzz = fuzz
+        genesis, privs = make_genesis(n_vals or n_nodes, chain_id=chain_id)
+        self.genesis, self.privs = genesis, privs
+        self.nodes = [
+            NemesisNode(
+                i,
+                genesis,
+                privs,
+                self.home,
+                chain_id,
+                config=config,
+                verifier=verifier_factory(i) if verifier_factory else None,
+                hasher=hasher_factory(i) if hasher_factory else None,
+            )
+            for i in range(n_nodes)
+        ]
+        # (i, j) i<j -> (chaos i->j, chaos j->i); flags survive re-links
+        self._links: dict[tuple[int, int], tuple[LinkChaos, LinkChaos]] = {}
+        self._partition: list[set[int]] | None = None
+        self._monitor_interval = monitor_interval_s
+        self._monitor: threading.Thread | None = None
+        self._monitor_stop = threading.Event()
+        self.violations: list[str] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "Nemesis":
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(check=exc_type is None)
+
+    def start(self) -> None:
+        for node in self.nodes:
+            node.start()
+        for i in range(len(self.nodes)):
+            for j in range(i + 1, len(self.nodes)):
+                self._connect(i, j)
+        self._monitor_stop.clear()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="nemesis-invariants", daemon=True
+        )
+        self._monitor.start()
+
+    def stop(self, check: bool = True) -> None:
+        self._monitor_stop.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        for node in self.nodes:
+            node.stop()
+        if check:
+            self.assert_invariants()
+
+    # -- wiring --------------------------------------------------------------
+
+    def _chaos_pair(self, i: int, j: int) -> tuple[LinkChaos, LinkChaos]:
+        key = (min(i, j), max(i, j))
+        if key not in self._links:
+            self._links[key] = (LinkChaos(seed=key[0]), LinkChaos(seed=key[1]))
+            if self._partition is not None and self._crosses_partition(i, j):
+                for c in self._links[key]:
+                    c.partitioned = True
+        return self._links[key]
+
+    def _connect(self, i: int, j: int) -> None:
+        c_ij, c_ji = self._chaos_pair(i, j)
+
+        def wrap(ea, eb):
+            if self.fuzz is not None:
+                ea = FuzzedEndpoint(ea, self.fuzz)
+                eb = FuzzedEndpoint(eb, self.fuzz)
+            return ChaosEndpoint(ea, c_ij), ChaosEndpoint(eb, c_ji)
+
+        connect_switches(self.nodes[i].switch, self.nodes[j].switch, wrap=wrap)
+
+    # -- fault primitives ----------------------------------------------------
+
+    def _crosses_partition(self, i: int, j: int) -> bool:
+        assert self._partition is not None
+        for group in self._partition:
+            if i in group and j in group:
+                return False
+        return True
+
+    def partition(self, *groups) -> None:
+        """Split the network into isolated groups, e.g.
+        `partition({0, 1}, {2, 3})`. Links inside a group stay clean;
+        links across groups black-hole in both directions. A node in no
+        listed group is isolated entirely."""
+        self._partition = [set(g) for g in groups]
+        for (i, j), (c_ij, c_ji) in self._links.items():
+            cut = self._crosses_partition(i, j)
+            c_ij.partitioned = cut
+            c_ji.partitioned = cut
+        kv(_log, logging.INFO, "partition", groups=str(groups))
+
+    def heal(self) -> None:
+        """Remove the partition (other per-link chaos keeps its settings)."""
+        self._partition = None
+        for c_ij, c_ji in self._links.values():
+            c_ij.partitioned = False
+            c_ji.partitioned = False
+        kv(_log, logging.INFO, "heal", links=len(self._links))
+
+    def delay(self, i: int, j: int, seconds: float, both_ways: bool = True) -> None:
+        c_ij, c_ji = self._chaos_pair(i, j)
+        c_ij.delay_s = seconds
+        if both_ways:
+            c_ji.delay_s = seconds
+
+    def duplicate(self, i: int, j: int, prob: float, both_ways: bool = True) -> None:
+        c_ij, c_ji = self._chaos_pair(i, j)
+        c_ij.dup_prob = prob
+        if both_ways:
+            c_ji.dup_prob = prob
+
+    def crash(self, i: int) -> None:
+        self.nodes[i].crash()
+
+    def restart(self, i: int) -> None:
+        """Restart a crashed node and re-link it to every running node
+        (links inherit the live partition state)."""
+        node = self.nodes[i]
+        node.restart()
+        for j, other in enumerate(self.nodes):
+            if j == i or not other.running:
+                continue
+            key = (min(i, j), max(i, j))
+            self._links.pop(key, None)  # old endpoints died with the crash
+            self._connect(*key)
+
+    def crash_at_fail_point(self, index: int) -> None:
+        """Arm the process-wide fail-point counter (`utils/fail.py`) in
+        SOFT mode: the `index`-th fail_point() call from now raises
+        SimulatedCrash, killing that node's consensus thread mid-step.
+        Counts are process-global — all nodes' persistence steps share
+        the sequence, like the reference's kill-at-every-index matrix."""
+        from tendermint_tpu.utils import fail
+
+        fail.reset_for_testing()
+        os.environ["FAIL_TEST_SOFT"] = "1"
+        os.environ["FAIL_TEST_INDEX"] = str(index)
+
+    def clear_fail_point(self) -> None:
+        os.environ.pop("FAIL_TEST_INDEX", None)
+        os.environ.pop("FAIL_TEST_SOFT", None)
+
+    # -- WAL damage ----------------------------------------------------------
+
+    def truncate_wal_tail(self, i: int, nbytes: int = 16) -> None:
+        """Chop `nbytes` off the crashed node's live WAL file — the torn
+        tail a mid-write crash leaves. Replay must tolerate it."""
+        node = self.nodes[i]
+        if node.running:
+            raise RuntimeError("truncate_wal_tail on a running node")
+        size = os.path.getsize(node.wal_path)
+        with open(node.wal_path, "ab") as f:
+            f.truncate(max(0, size - nbytes))
+
+    def corrupt_wal_tail(self, i: int, nbytes: int = 16) -> None:
+        """Flip the last `nbytes` of the crashed node's WAL (bit rot /
+        torn write with garbage). The CRC framing must reject the tail."""
+        node = self.nodes[i]
+        if node.running:
+            raise RuntimeError("corrupt_wal_tail on a running node")
+        size = os.path.getsize(node.wal_path)
+        if size == 0:
+            return
+        n = min(nbytes, size)
+        with open(node.wal_path, "r+b") as f:
+            f.seek(size - n)
+            tail = f.read(n)
+            f.seek(size - n)
+            f.write(bytes(b ^ 0xFF for b in tail))
+
+    # -- invariants ----------------------------------------------------------
+
+    def heights(self) -> list[int]:
+        return [n.store.height for n in self.nodes]
+
+    def check_no_fork(self) -> None:
+        """One block hash per height across every store that has it."""
+        top = max(self.heights(), default=0)
+        for h in range(1, top + 1):
+            seen: dict[bytes, int] = {}
+            for node in self.nodes:
+                meta = node.store.load_block_meta(h)
+                if meta is not None:
+                    seen.setdefault(bytes(meta.block_id.hash), node.index)
+            if len(seen) > 1:
+                raise InvariantViolation(
+                    f"FORK at height {h}: {[(v, k.hex()[:12]) for k, v in seen.items()]}"
+                )
+
+    def check_commit_agreement(self) -> None:
+        """Every stored seen-commit certifies the block stored at that
+        height (a node must never store a commit for one block and the
+        data of another)."""
+        for node in self.nodes:
+            for h in range(1, node.store.height + 1):
+                meta = node.store.load_block_meta(h)
+                commit = node.store.load_seen_commit(h)
+                if meta is None or commit is None:
+                    continue
+                if bytes(commit.block_id.hash) != bytes(meta.block_id.hash):
+                    raise InvariantViolation(
+                        f"node{node.index} height {h}: seen-commit certifies "
+                        f"{commit.block_id.hash.hex()[:12]} but stored block is "
+                        f"{meta.block_id.hash.hex()[:12]}"
+                    )
+
+    def check_invariants(self) -> None:
+        self.check_no_fork()
+        self.check_commit_agreement()
+
+    def assert_invariants(self) -> None:
+        """Raise the first violation the background monitor recorded,
+        then re-check once on the final state."""
+        if self.violations:
+            raise InvariantViolation(self.violations[0])
+        self.check_invariants()
+
+    def _monitor_loop(self) -> None:
+        while not self._monitor_stop.wait(self._monitor_interval):
+            try:
+                self.check_invariants()
+            except InvariantViolation as e:
+                self.violations.append(str(e))
+                kv(_log, logging.ERROR, "invariant violated", error=str(e)[:200])
+                return  # state is already poisoned; keep the first report
+
+    # -- progress ------------------------------------------------------------
+
+    def wait_height(
+        self,
+        height: int,
+        nodes: list[int] | None = None,
+        timeout: float = 60.0,
+    ) -> None:
+        """Block until the given nodes' stores reach `height` (eventual
+        progress — e.g. after heal). Raises on timeout or violation."""
+        targets = nodes if nodes is not None else range(len(self.nodes))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.violations:
+                raise InvariantViolation(self.violations[0])
+            if all(self.nodes[i].store.height >= height for i in targets):
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"heights {self.heights()} did not reach {height} in {timeout}s"
+        )
+
+    def wait_progress(
+        self,
+        delta: int = 1,
+        nodes: list[int] | None = None,
+        timeout: float = 60.0,
+    ) -> int:
+        """Wait for `delta` MORE committed heights on the given nodes;
+        returns the new minimum height."""
+        targets = list(nodes if nodes is not None else range(len(self.nodes)))
+        base = min(self.nodes[i].store.height for i in targets)
+        self.wait_height(base + delta, nodes=targets, timeout=timeout)
+        return min(self.nodes[i].store.height for i in targets)

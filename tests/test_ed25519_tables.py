@@ -19,7 +19,10 @@ from tendermint_tpu.ops import ed25519_tables as tb
 # Device-kernel compiles dominate runtime (~minutes per bucket shape);
 # excluded from the default selection (pytest.ini addopts) — run with
 #   pytest -m kernel
-pytestmark = pytest.mark.kernel
+# kernel suites are also 'slow': tier-1 CI selects -m 'not slow' (which
+# overrides the ini's 'not kernel' default), and these compile device
+# kernels on XLA:CPU for minutes. 'pytest -m kernel' still runs them.
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]
 
 
 def _keyed_batch(n, seed=1):

@@ -24,7 +24,10 @@ from tendermint_tpu.ops.padding import (
 # Device-kernel compiles dominate runtime (~minutes per bucket shape);
 # excluded from the default selection (pytest.ini addopts) — run with
 #   pytest -m kernel
-pytestmark = pytest.mark.kernel
+# kernel suites are also 'slow': tier-1 CI selects -m 'not slow' (which
+# overrides the ini's 'not kernel' default), and these compile device
+# kernels on XLA:CPU for minutes. 'pytest -m kernel' still runs them.
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]
 
 LENGTHS = [0, 1, 3, 31, 32, 55, 56, 63, 64, 65, 111, 112, 127, 128, 129, 200, 300]
 

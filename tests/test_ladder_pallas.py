@@ -24,7 +24,10 @@ from tendermint_tpu.ops.ed25519_kernel import (
     verify_kernel,
 )
 
-pytestmark = pytest.mark.kernel
+# kernel suites are also 'slow': tier-1 CI selects -m 'not slow' (which
+# overrides the ini's 'not kernel' default), and these compile device
+# kernels on XLA:CPU for minutes. 'pytest -m kernel' still runs them.
+pytestmark = [pytest.mark.kernel, pytest.mark.slow]
 
 
 def _batch(n, corrupt=(), bad_pub=(), bad_r=()):

@@ -1,0 +1,148 @@
+"""Interpret-mode smoke test for the pallas ladder's call plumbing.
+
+`tests/test_ladder_pallas.py` gates the ladder MATH on CPU (plane ops
+as plain jnp), but the pallas_call mechanics — BlockSpec index_maps,
+grid order, the t==0 scratch reset, the final-step out write — had no
+CPU coverage: an index_map regression would surface only on TPU runs.
+
+Full-geometry interpret mode is unusable as a test budget (>10 min per
+call; even a toy-geometry graph takes XLA ~5 min to compile because of
+the 20-limb field math). So this file shrinks BOTH dimensions:
+
+* toy geometry via monkeypatched SCALAR_BITS / MIN_LANES /
+  MAX_TILE_LANES (8 lanes, 6 ladder steps, one (8, 1)-plane tile);
+* the field math (`_double_planes` / `_madd_planes`) replaced with
+  cheap shape-preserving arithmetic — the kernel resolves them from
+  module globals, so the REAL kernel body still runs, block indexing
+  and all; only the limb math inside is substituted. The math itself
+  is separately CPU-gated by test_ladder_pallas.py.
+
+Any change that misindexes a BlockSpec, reorders the grid, skips the
+scratch reset, or drops the final-step write now fails on CPU CI in
+about a second.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import tendermint_tpu.ops.ed25519_ladder_pallas as lad  # noqa: E402
+
+TOY_BITS = 6
+TOY_LANES = 8  # one (8, 1)-plane tile
+
+
+def _cheap_double(acc):
+    """Stands in for _double_planes: per-plane, invertible, cheap."""
+    return tuple([2 * p for p in coord] for coord in acc)
+
+
+def _cheap_madd(acc, ypx, ymx, t2d):
+    """Stands in for _madd_planes: mixes acc with all three entry
+    groups so a wrong/missing entry select shows up in the output."""
+    x, y, z, t = acc
+    return (
+        [a + e for a, e in zip(x, ypx)],
+        [a + e for a, e in zip(y, ymx)],
+        [a + e for a, e in zip(z, t2d)],
+        [a + e1 - e2 for a, e1, e2 in zip(t, ypx, ymx)],
+    )
+
+
+@pytest.fixture
+def toy_kernel(monkeypatch):
+    monkeypatch.setattr(lad, "SCALAR_BITS", TOY_BITS)
+    monkeypatch.setattr(lad, "MIN_LANES", TOY_LANES)
+    monkeypatch.setattr(lad, "MAX_TILE_LANES", TOY_LANES)
+    monkeypatch.setattr(lad, "_double_planes", _cheap_double)
+    monkeypatch.setattr(lad, "_madd_planes", _cheap_madd)
+
+
+def _toy_inputs(rng, tiles=1, w=TOY_LANES // 8):
+    gtab = rng.integers(0, 1 << 8, size=(tiles, 4, 60, 8, w), dtype=np.int32)
+    dig = rng.integers(0, 4, size=(tiles, TOY_BITS, 8, w), dtype=np.int32)
+    return jnp.asarray(gtab), jnp.asarray(dig)
+
+
+def _host_reference(gtab, dig, w):
+    """The kernel body's semantics step by step in plain numpy/jnp:
+    t==0 identity init, double, 4-way masked entry select, madd —
+    mirrors _make_ladder_kernel including msb-first step order."""
+    tiles = gtab.shape[0]
+    outs = []
+    for i in range(tiles):
+        rows = jax.lax.broadcasted_iota(jnp.int32, (80, 8, w), 0)
+        acc_arr = jnp.where((rows == 20) | (rows == 40), 1, 0)
+        for t in range(TOY_BITS):
+            acc = tuple(
+                [acc_arr[20 * ci + k] for k in range(20)] for ci in range(4)
+            )
+            acc = _cheap_double(acc)
+            d = dig[i, t]
+            gt = gtab[i]
+            masks = [d == k for k in range(4)]
+            ent = []
+            for limb in range(60):
+                v = jnp.where(masks[0], gt[0, limb], 0)
+                for k in range(1, 4):
+                    v = v + jnp.where(masks[k], gt[k, limb], 0)
+                ent.append(v)
+            nxt = _cheap_madd(acc, ent[:20], ent[20:40], ent[40:])
+            acc_arr = jnp.stack([p for coord in nxt for p in coord])
+        outs.append(acc_arr)
+    return jnp.stack(outs)
+
+
+def _coords_from_out(out, tiles, w):
+    coords = out.reshape(tiles, 4, 20, 8, w)
+    return jnp.transpose(coords, (1, 0, 3, 4, 2)).reshape(4, -1, lad.NLIMBS)
+
+
+class TestInterpretPlumbing:
+    def test_single_tile_matches_host_reference(self, toy_kernel):
+        rng = np.random.default_rng(7)
+        gtab, dig = _toy_inputs(rng)
+        got = lad._ladder_pallas(gtab, dig, w=1, interpret=True)
+        expect = _coords_from_out(_host_reference(gtab, dig, 1), 1, 1)
+        for c, (g, e) in enumerate(zip(got, expect)):
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(e), err_msg=f"coord {c}"
+            )
+
+    def test_multi_tile_grid_indexing(self, toy_kernel):
+        """Two tiles with DIFFERENT tables/digits: a wrong index_map
+        (swapped grid axes, off-by-one block origin) collapses the
+        tiles onto each other and fails this comparison."""
+        rng = np.random.default_rng(11)
+        gtab, dig = _toy_inputs(rng, tiles=2)
+        got = lad._ladder_pallas(gtab, dig, w=1, interpret=True)
+        expect = _coords_from_out(_host_reference(gtab, dig, 1), 2, 1)
+        for g, e in zip(got, expect):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(e))
+        x = np.asarray(got[0])
+        assert not np.array_equal(x[:TOY_LANES], x[TOY_LANES:])
+
+    def test_digit_schedule_is_msb_first(self, monkeypatch):
+        """_ladder_digits column t must be bit (SCALAR_BITS-1-t): the
+        kernel consumes digits msb-first via the (i, t) BlockSpec."""
+        monkeypatch.setattr(lad, "SCALAR_BITS", TOY_BITS)
+        s = np.zeros((2, 32), dtype=np.uint8)
+        h = np.zeros((2, 32), dtype=np.uint8)
+        s[0, 0] = 0b100001  # bits 0 and 5 of lane 0
+        h[1, 0] = 0b000010  # bit 1 of lane 1
+        dig = np.asarray(lad._ladder_digits(jnp.asarray(s), jnp.asarray(h)))
+        assert dig.shape == (2, TOY_BITS)
+        assert dig[0].tolist() == [1, 0, 0, 0, 0, 1]  # s bits, msb first
+        assert dig[1].tolist() == [0, 0, 0, 0, 2, 0]  # h bit -> selector 2
+
+    def test_tile_lanes_rejects_sub_minimum_batches(self, monkeypatch):
+        monkeypatch.setattr(lad, "MAX_TILE_LANES", TOY_LANES)
+        monkeypatch.setattr(lad, "MIN_LANES", TOY_LANES)
+        assert lad._tile_lanes(TOY_LANES) == TOY_LANES
+        assert lad._tile_lanes(4 * TOY_LANES) == TOY_LANES
+        with pytest.raises(ValueError):
+            lad._tile_lanes(TOY_LANES - 2)

@@ -1,0 +1,212 @@
+"""Network chaos harness: invariants under partitions, crashes, WAL
+damage, lossy links, and device faults.
+
+The acceptance scenario for the resilience subsystem lives here:
+`TestDeviceFaultDegradation` trips the verifier circuit breaker
+MID-HEIGHT on every node of a running consensus network and proves the
+chain keeps committing on the host fallback (no fork, height progress),
+then clears the fault and proves the breaker re-closes.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tendermint_tpu.p2p.transport import (
+    ChaosEndpoint,
+    FuzzConfig,
+    FuzzedEndpoint,
+    LinkChaos,
+    pipe_pair,
+)
+from tendermint_tpu.services.resilient import ResilientVerifier
+from tendermint_tpu.services.verifier import HostBatchVerifier
+from tendermint_tpu.testing import Nemesis
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.circuit import CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fail.clear_device_faults()
+    yield
+    fail.clear_device_faults()
+
+
+class TestChaosTransport:
+    def test_partition_black_holes_sends(self):
+        a, b = pipe_pair()
+        chaos = LinkChaos()
+        ca = ChaosEndpoint(a, chaos)
+        ca.send(b"before")
+        assert b.recv(timeout=1) == b"before"
+        chaos.partitioned = True
+        assert ca.send(b"during")  # swallowed, not an error
+        chaos.partitioned = False
+        ca.send(b"after")
+        assert b.recv(timeout=1) == b"after"  # 'during' is gone
+
+    def test_duplicate_delivers_twice(self):
+        a, b = pipe_pair()
+        chaos = LinkChaos(seed=1)
+        chaos.dup_prob = 1.0
+        ChaosEndpoint(a, chaos).send(b"x")
+        assert b.recv(timeout=1) == b"x"
+        assert b.recv(timeout=1) == b"x"
+
+    def test_delay_defers_delivery(self):
+        a, b = pipe_pair()
+        chaos = LinkChaos()
+        chaos.delay_s = 0.15
+        ChaosEndpoint(a, chaos).send(b"later")
+        with pytest.raises(TimeoutError):
+            b.recv(timeout=0.05)
+        assert b.recv(timeout=1) == b"later"
+
+    def test_fuzz_dup_probability(self):
+        a, b = pipe_pair()
+        fa = FuzzedEndpoint(a, FuzzConfig(prob_dup=1.0, seed=3))
+        fa.send(b"d")
+        assert b.recv(timeout=1) == b"d"
+        assert b.recv(timeout=1) == b"d"
+
+
+def _resilient_factory(threshold=2, reset_s=0.5):
+    def factory(_i):
+        return ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(
+                failure_threshold=threshold, reset_timeout_s=reset_s
+            ),
+            max_retries=0,
+        )
+
+    return factory
+
+
+class TestDeviceFaultDegradation:
+    def test_breaker_trips_mid_height_chain_keeps_committing(self, tmp_path):
+        """THE acceptance scenario: env-forced verifier device faults on
+        a running network -> breakers trip OPEN -> blocks keep
+        committing on the host fallback (no fork, height progress) ->
+        fault clears -> breakers re-close."""
+        with Nemesis(
+            4, home=str(tmp_path), verifier_factory=_resilient_factory()
+        ) as net:
+            net.wait_height(2, timeout=60)
+
+            fail.set_device_fault("verify")  # device 'dies' mid-consensus
+            net.wait_progress(delta=2, timeout=60)  # liveness on fallback
+            # every node is degraded (open, or half_open between probes —
+            # probes keep failing while the fault is armed)
+            tripped = [n.cs.verifier.breaker.state for n in net.nodes]
+            assert all(s != "closed" for s in tripped), tripped
+            assert all(n.cs.verifier.breaker.times_opened > 0 for n in net.nodes)
+            assert all(
+                n.cs.verifier.snapshot()["fallback_calls"] > 0 for n in net.nodes
+            )
+            net.check_invariants()  # safety on fallback (no fork)
+
+            fail.clear_device_faults()  # device 'recovers'
+            net.wait_progress(delta=2, timeout=60)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(
+                    n.cs.verifier.breaker.state == "closed" for n in net.nodes
+                ):
+                    break
+                time.sleep(0.1)
+            states = [n.cs.verifier.breaker.state for n in net.nodes]
+            assert all(s == "closed" for s in states), states
+            net.wait_progress(delta=1, timeout=60)  # still live re-upgraded
+
+
+class TestPartitionHeal:
+    def test_even_split_stalls_then_heals(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.partition({0, 1}, {2, 3})  # no quorum on either side
+            before = max(net.heights())
+            time.sleep(1.5)
+            assert max(net.heights()) <= before + 1  # at most in-flight height
+            net.heal()
+            net.wait_height(before + 2, timeout=60)  # progress resumes
+
+    def test_minority_partition_keeps_majority_committing(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.partition({0, 1, 2}, {3})  # 75% quorum keeps going
+            net.wait_progress(delta=2, nodes=[0, 1, 2], timeout=60)
+            net.heal()
+            # the isolated node catches back up after heal
+            target = max(net.heights())
+            net.wait_height(target, nodes=[3], timeout=60)
+
+
+class TestCrashRecovery:
+    def test_crash_restart_resumes_and_catches_up(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.crash(0)
+            net.wait_progress(delta=1, nodes=[1, 2, 3], timeout=60)
+            net.restart(0)
+            target = max(net.heights()) + 1
+            net.wait_height(target, timeout=60)
+
+    def test_corrupt_wal_tail_is_tolerated_on_restart(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.crash(0)
+            net.corrupt_wal_tail(0, nbytes=32)  # torn-write garbage
+            net.restart(0)
+            net.wait_height(max(net.heights()) + 1, timeout=60)
+
+    def test_truncated_wal_tail_is_tolerated_on_restart(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.crash(2)
+            net.truncate_wal_tail(2, nbytes=24)
+            net.restart(2)
+            net.wait_height(max(net.heights()) + 1, timeout=60)
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_lossy_duplicating_network_stays_consistent(self, tmp_path):
+        """Background fuzz (drops + dups) on every link, a crash-restart
+        and a partition cycle on top — invariants checked continuously."""
+        fuzz = FuzzConfig(prob_drop_rw=0.05, prob_dup=0.10, seed=42)
+        with Nemesis(4, home=str(tmp_path), fuzz=fuzz) as net:
+            net.wait_height(3, timeout=120)
+            net.partition({0, 3}, {1, 2})
+            time.sleep(1.0)
+            net.heal()
+            net.wait_progress(delta=2, timeout=120)
+            net.crash(1)
+            net.restart(1)
+            net.wait_height(max(net.heights()) + 2, timeout=120)
+
+    def test_soft_fail_point_crashes_one_node_in_process(self, tmp_path):
+        """FAIL_TEST_INDEX composition: the soft mode kills ONE node's
+        consensus thread at a persistence step; restart + WAL replay
+        recover it while the rest of the network keeps going."""
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            net.crash_at_fail_point(5)
+            try:
+                deadline = time.monotonic() + 30
+                victim = None
+                while time.monotonic() < deadline and victim is None:
+                    for n in net.nodes:
+                        t = n.cs._thread
+                        if t is not None and not t.is_alive():
+                            victim = n.index
+                    time.sleep(0.1)
+                assert victim is not None, "no consensus thread died at fail point"
+            finally:
+                net.clear_fail_point()
+            net.crash(victim)
+            net.restart(victim)
+            net.wait_height(max(net.heights()) + 1, timeout=120)

@@ -267,3 +267,79 @@ class TestSwitch:
         finally:
             for s in switches:
                 s.stop()
+
+
+class TestPersistentAddrMatching:
+    """node.Node persistent-peer adoption: id-pinned (`id@host:port`)
+    matching, with bare-host match only when unambiguous (several NAT'd
+    peers can share one IP — mapping the wrong one redials the wrong
+    address after a drop)."""
+
+    @staticmethod
+    def _node_with_peers(peers):
+        from tendermint_tpu.node.node import Node
+
+        class _FakeSwitch:
+            def __init__(self, ps):
+                self._ps = ps
+
+            def peers(self):
+                return self._ps
+
+        n = Node.__new__(Node)
+        n._peer_addr = {}
+        n.switch = _FakeSwitch(peers)
+        return n
+
+    @staticmethod
+    def _peer(pid, listen_addr="", remote_addr=""):
+        class _P:
+            id = pid
+
+        p = _P()
+        p.node_info = NodeInfo(node_id=pid, moniker=pid, chain_id="c", listen_addr=listen_addr)
+        p.remote_addr = remote_addr
+        return p
+
+    def test_split_persistent_addr(self):
+        from tendermint_tpu.node.node import Node
+
+        assert Node._split_persistent_addr("abc123@10.0.0.1:46656") == (
+            "abc123",
+            "10.0.0.1:46656",
+        )
+        assert Node._split_persistent_addr("10.0.0.1:46656") == (None, "10.0.0.1:46656")
+        assert Node._split_persistent_addr("tcp://10.0.0.1:46656") == (
+            None,
+            "tcp://10.0.0.1:46656",
+        )
+
+    def test_id_pinned_match_beats_host_match(self):
+        right = self._peer("idA", remote_addr="10.0.0.1:5555")
+        wrong = self._peer("idB", remote_addr="10.0.0.1:6666")  # same NAT host
+        n = self._node_with_peers([wrong, right])
+        n._adopt_inbound_persistent("idA@10.0.0.1:46656")
+        assert n._peer_addr == {"idA": "idA@10.0.0.1:46656"}
+
+    def test_pinned_id_absent_adopts_nothing(self):
+        other = self._peer("idB", remote_addr="10.0.0.1:6666")
+        n = self._node_with_peers([other])
+        n._adopt_inbound_persistent("idA@10.0.0.1:46656")
+        assert n._peer_addr == {}
+
+    def test_bare_host_match_requires_single_candidate(self):
+        a = self._peer("idA", remote_addr="10.0.0.1:5555")
+        b = self._peer("idB", remote_addr="10.0.0.1:6666")
+        n = self._node_with_peers([a, b])
+        n._adopt_inbound_persistent("10.0.0.1:46656")
+        assert n._peer_addr == {}  # ambiguous: refuse to guess
+        n2 = self._node_with_peers([a])
+        n2._adopt_inbound_persistent("10.0.0.1:46656")
+        assert n2._peer_addr == {"idA": "10.0.0.1:46656"}
+
+    def test_listen_addr_equality_match(self):
+        a = self._peer("idA", listen_addr="10.0.0.1:46656", remote_addr="10.9.9.9:1")
+        b = self._peer("idB", remote_addr="10.0.0.1:2")
+        n = self._node_with_peers([b, a])
+        n._adopt_inbound_persistent("10.0.0.1:46656")
+        assert n._peer_addr == {"idA": "10.0.0.1:46656"}

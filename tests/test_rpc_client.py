@@ -260,13 +260,15 @@ class TestWebSocketSubscribe:
             solo_node.rpc.start()
             # already-buffered frames may still drain, but the stream must
             # END promptly instead of healing into a live one (healing
-            # would keep yielding new blocks until the 30s quiet timeout)
+            # would keep yielding new blocks until the 30s quiet timeout).
+            # A dead conn on a non-closed client is a hard error, so the
+            # caller can tell "no events" from "connection lost".
             import time as _t
 
             t0 = _t.monotonic()
-            list(ws.events(timeout=30))
+            with pytest.raises(RPCClientError):
+                list(ws.events(timeout=30))
             assert _t.monotonic() - t0 < 10
-            assert list(ws.events(timeout=2)) == []
         finally:
             ws.close()
 

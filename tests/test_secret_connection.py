@@ -5,6 +5,12 @@ import threading
 
 import pytest
 
+pytest.importorskip(
+    "cryptography",
+    reason="SecretConnection needs the cryptography package (X25519/AEAD); "
+    "there is deliberately NO pure-Python fallback for transport crypto",
+)
+
 from tendermint_tpu.crypto.keys import PrivKey
 from tendermint_tpu.p2p.secret import HandshakeError, SecretEndpoint
 from tendermint_tpu.p2p.transport import EndpointClosed, pipe_pair
