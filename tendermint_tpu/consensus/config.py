@@ -15,6 +15,14 @@ class ConsensusConfig:
     timeout_precommit: int = 1000
     timeout_precommit_delta: int = 500
     timeout_commit: int = 1000
+    # Round-skip deadline while parked at PREVOTE/PRECOMMIT without the
+    # +2/3-any that would arm the *_wait timeouts (the CPU-starvation
+    # liveness gap: gossip can idle for seconds and nothing else moves
+    # the round forward). Generous by design — healthy networks never
+    # hit it; 0 disables. Fires as later Tendermint's OnTimeoutPrevote/
+    # OnTimeoutPrecommit: precommit nil, then next round.
+    timeout_round_skip: int = 10_000
+    timeout_round_skip_delta: int = 2_000
     skip_timeout_commit: bool = False
     create_empty_blocks: bool = True
     create_empty_blocks_interval: int = 0  # seconds
@@ -36,6 +44,15 @@ class ConsensusConfig:
     def commit_timeout(self) -> float:
         return self.timeout_commit / 1000.0
 
+    def round_skip_timeout(self, round_: int) -> float:
+        """Seconds before a starved PREVOTE/PRECOMMIT skips ahead; <= 0
+        means disabled."""
+        if self.timeout_round_skip <= 0:
+            return 0.0
+        return (
+            self.timeout_round_skip + self.timeout_round_skip_delta * round_
+        ) / 1000.0
+
     @classmethod
     def test_config(cls) -> "ConsensusConfig":
         """Shrunk timeouts (reference `TestConsensusConfig
@@ -48,5 +65,10 @@ class ConsensusConfig:
             timeout_precommit=10,
             timeout_precommit_delta=1,
             timeout_commit=10,
+            # long enough that loaded CI never skips a healthy round,
+            # short enough that starvation tests finish (nemesis tunes
+            # it further down for its round-skip scenario)
+            timeout_round_skip=2_000,
+            timeout_round_skip_delta=100,
             skip_timeout_commit=True,
         )

@@ -53,6 +53,8 @@ from tendermint_tpu.types.services import NopMempool
 from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
 from tendermint_tpu.types.vote_set import VoteSet
+from tendermint_tpu.telemetry import TRACER
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils import log as _log_mod
 import logging as _logging
@@ -127,6 +129,13 @@ class ConsensusState:
         self.votes: HeightVoteSet | None = None
         self.commit_round = -1
         self.last_commit: VoteSet | None = None
+
+        # telemetry: the open round-phase span and the height stopwatch
+        # (observed into tendermint_consensus_phase_seconds /
+        # _height_seconds and the span tracer on every transition)
+        self._phase_name: str | None = None
+        self._phase_started = time_mod.monotonic()
+        self._height_started = time_mod.monotonic()
 
         self._update_to_state(state)
         if hasattr(self.mempool, "set_on_txs_available"):
@@ -253,6 +262,8 @@ class ConsensusState:
                     else:
                         stashed = nxt
                         break
+            if batch is not None:
+                _metrics.VOTE_DRAIN_BATCH.observe(len(batch))
             try:
                 if batch is not None and len(batch) >= self.VOTE_DRAIN_MIN:
                     # per-vote fault isolation must hold on this path too
@@ -368,6 +379,29 @@ class ConsensusState:
     def _on_txs_available(self) -> None:
         self._queue.put(_TxsAvailable(self.height))
 
+    # ----------------------------------------------------------- telemetry
+
+    def _observe_phase(self, next_name: str | None) -> None:
+        """Close the open round-phase span (histogram + tracer) and open
+        `next_name`. Called on every phase transition under the state
+        lock; None closes without opening (height finalized)."""
+        now = time_mod.monotonic()
+        if self._phase_name is not None:
+            dur = now - self._phase_started
+            _metrics.CONSENSUS_PHASE_SECONDS.labels(
+                phase=self._phase_name
+            ).observe(dur)
+            wall_end = time_mod.time()
+            TRACER.add(
+                f"consensus.{self._phase_name}",
+                wall_end - dur,
+                wall_end,
+                height=self.height,
+                round=self.round,
+            )
+        self._phase_name = next_name
+        self._phase_started = now
+
     # ------------------------------------------------------ state plumbing
 
     def _update_to_state(self, state: State) -> None:
@@ -408,6 +442,10 @@ class ConsensusState:
         self.votes = HeightVoteSet(state.chain_id, self.height, validators)
         self.commit_round = -1
         self.last_commit = last_commit
+        self._phase_name = None
+        self._height_started = time_mod.monotonic()
+        _metrics.CONSENSUS_HEIGHT.set(self.height)
+        _metrics.CONSENSUS_ROUND.set(0)
 
     def _reconstruct_last_commit(self, state: State) -> VoteSet | None:
         """Rebuild the precommit VoteSet from the stored seen-commit
@@ -488,6 +526,17 @@ class ConsensusState:
         elif ti.step == RoundStepType.PROPOSE:
             self.event_switch.fire(ev.EVENT_TIMEOUT_PROPOSE, self._rs_event())
             self._enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStepType.PREVOTE:
+            # Round-skip (ROADMAP liveness gap): starved at PREVOTE with
+            # no +2/3-any to arm PrevoteWait — precommit nil and move on,
+            # later Tendermint's OnTimeoutPrevote. The guard above
+            # filtered this tock out if the round advanced on its own.
+            _metrics.CONSENSUS_ROUND_SKIPS.labels(phase="prevote").inc()
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStepType.PRECOMMIT:
+            # OnTimeoutPrecommit: starved at PRECOMMIT — next round.
+            _metrics.CONSENSUS_ROUND_SKIPS.labels(phase="precommit").inc()
+            self._enter_new_round(ti.height, ti.round + 1)
         elif ti.step == RoundStepType.PREVOTE_WAIT:
             self.event_switch.fire(ev.EVENT_TIMEOUT_WAIT, self._rs_event())
             self._enter_precommit(ti.height, ti.round)
@@ -525,6 +574,7 @@ class ConsensusState:
             self.proposal_block = None
             self.proposal_block_parts = None
         self.votes.set_round(round_ + 1)  # track next round for skipping
+        _metrics.CONSENSUS_ROUND.set(round_)
         self.event_switch.fire(ev.EVENT_NEW_ROUND, self._rs_event())
 
         wait_for_txs = (
@@ -594,6 +644,7 @@ class ConsensusState:
             return
         self.round = round_
         self.step = RoundStepType.PROPOSE
+        self._observe_phase("propose")
         self._new_step()
         self._schedule_timeout(
             self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
@@ -713,7 +764,14 @@ class ConsensusState:
             return
         self.round = round_
         self.step = RoundStepType.PREVOTE
+        self._observe_phase("prevote")
         self._new_step()
+        skip = self.config.round_skip_timeout(round_)
+        if skip > 0:
+            # round-skip deadline: replaced by PrevoteWait/Precommit
+            # scheduling when votes actually flow (ticker keys order by
+            # step), fires only if this round truly starves here
+            self._schedule_timeout(skip, height, round_, RoundStepType.PREVOTE)
         self.do_prevote_fn(height, round_)
 
     def _default_do_prevote(self, height: int, round_: int) -> None:
@@ -766,7 +824,11 @@ class ConsensusState:
             return
         self.round = round_
         self.step = RoundStepType.PRECOMMIT
+        self._observe_phase("precommit")
         self._new_step()
+        skip = self.config.round_skip_timeout(round_)
+        if skip > 0:
+            self._schedule_timeout(skip, height, round_, RoundStepType.PRECOMMIT)
 
         prevotes = self.votes.prevotes(round_)
         block_id = prevotes.two_thirds_majority() if prevotes is not None else None
@@ -849,6 +911,7 @@ class ConsensusState:
         self.commit_round = commit_round
         self.commit_time = time_mod.time()
         self.step = RoundStepType.COMMIT
+        self._observe_phase("commit")
         self._new_step()
 
         block_id = self.votes.precommits(commit_round).two_thirds_majority()
@@ -915,6 +978,20 @@ class ConsensusState:
             )
 
             fail_point()  # applied, before round-state reset
+            self._observe_phase(None)  # closes the "commit" span
+            height_wall = time_mod.monotonic() - self._height_started
+            _metrics.CONSENSUS_HEIGHT_SECONDS.observe(height_wall)
+            _metrics.CONSENSUS_COMMITS.inc()
+            _metrics.CONSENSUS_TXS_COMMITTED.inc(len(block.data.txs))
+            wall_end = time_mod.time()
+            TRACER.add(
+                "consensus.height",
+                wall_end - height_wall,
+                wall_end,
+                height=height,
+                round=self.commit_round,
+                txs=len(block.data.txs),
+            )
             self._update_to_state(state_copy)
         except FatalConsensusError:
             raise

@@ -15,11 +15,13 @@ from __future__ import annotations
 
 import os
 import struct
+import time
 import zlib
 from dataclasses import dataclass
 from typing import Iterator
 
 from tendermint_tpu.codec import Reader, Writer
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.types.part_set import Part
 from tendermint_tpu.types.proposal import Proposal
 from tendermint_tpu.types.vote import Vote
@@ -175,9 +177,14 @@ class WAL:
                 return  # light mode: drop peer block-parts
         body = _encode_record(item)
         frame = struct.pack(">II", zlib.crc32(body) & 0xFFFFFFFF, len(body)) + body
+        # the fsync IS the consensus write barrier — its latency gates
+        # every input the receive loop processes, so it gets a histogram
+        t0 = time.perf_counter()
         self._f.write(frame)
         self._f.flush()
         os.fsync(self._f.fileno())
+        _metrics.WAL_FSYNC_SECONDS.observe(time.perf_counter() - t0)
+        _metrics.WAL_WRITTEN_BYTES.inc(len(frame))
         # rotate only at height boundaries: every segment then starts
         # with the records of a fresh height (replay never spans a cut)
         if (

@@ -19,6 +19,7 @@ from typing import Callable
 
 from tendermint_tpu.abci.client import AppConnMempool
 from tendermint_tpu.abci.types import CodeType, Result
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.types.tx import Tx, Txs
 
 DEFAULT_CACHE_SIZE = 100_000
@@ -101,6 +102,7 @@ class Mempool:
         with self._lock:
             self._txs.clear()
             self._cache.reset()
+            _metrics.MEMPOOL_SIZE.set(0)
 
     def check_tx(self, tx: Tx, cb: Callable[[Result], None] | None = None) -> Result:
         """Validate through the app; good txs join the pool.
@@ -113,6 +115,7 @@ class Mempool:
             # Non-zero code so RPC/broadcast callers can distinguish an
             # accepted tx from a silently-dropped duplicate (reference
             # returns ErrTxInCache, mempool.go:172-178).
+            _metrics.MEMPOOL_TXS.labels(result="duplicate").inc()
             res = Result(
                 code=CodeType.TX_IN_CACHE, log="tx already exists in cache"
             )
@@ -132,11 +135,14 @@ class Mempool:
             with self._lock:
                 self._counter += 1
                 self._txs.append(MempoolTx(self._counter, self._height, tx))
+                _metrics.MEMPOOL_SIZE.set(len(self._txs))
                 self._notify_txs_available()
                 self._txs_available.notify_all()
+            _metrics.MEMPOOL_TXS.labels(result="ok").inc()
         else:
             # bad tx: evict from cache so a corrected app state can re-admit
             self._cache.remove(tx)
+            _metrics.MEMPOOL_TXS.labels(result="rejected").inc()
         if cb is not None:
             cb(res)
         return res
@@ -166,6 +172,7 @@ class Mempool:
                         self._cache.remove(m.tx)
                 keep = still_good
             self._txs = keep
+            _metrics.MEMPOOL_SIZE.set(len(keep))
             if keep:
                 self._notify_txs_available()
 

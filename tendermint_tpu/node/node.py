@@ -286,6 +286,11 @@ class Node:
                 # must set p2p.external_address or peers learn loopback
                 adv_host = "127.0.0.1" if host in ("0.0.0.0", "") else host
                 self.switch.listen_addr = f"{adv_host}:{self.listener.port}"
+        # live-view gauges (peer count, p2p rates, mempool depth) read
+        # through this node at scrape time (`GET /metrics`)
+        from tendermint_tpu.telemetry.metrics import bind_node_gauges
+
+        bind_node_gauges(self)
         self.switch.start()  # reactors start; consensus starts unless fast-syncing
         if self.listener is not None:
             self.listener.start_accepting()

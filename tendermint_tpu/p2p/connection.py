@@ -20,6 +20,7 @@ from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.utils.flowrate import Monitor
 
 # Internal keepalive channel (reference sends dedicated packetTypePing/
@@ -183,6 +184,9 @@ class MConnection:
                 self.send_monitor.throttle()
                 self._endpoint.send(frame)
                 self.send_monitor.update(len(frame))
+                # process-wide throughput counter alongside the per-peer
+                # monitor (rates come from the monitors at scrape time)
+                _metrics.P2P_SENT_BYTES.inc(len(frame))
                 ch.recently_sent += len(payload)
         except EndpointClosed:
             self._die(None)
@@ -196,6 +200,7 @@ class MConnection:
             while self._running:
                 frame = self._endpoint.recv()
                 self.recv_monitor.update(len(frame))
+                _metrics.P2P_RECV_BYTES.inc(len(frame))
                 # inbound flow control: delay further reads once over
                 # the cap (the sender blocks on TCP backpressure)
                 self.recv_monitor.throttle()

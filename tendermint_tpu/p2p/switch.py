@@ -134,6 +134,14 @@ class Switch:
         with self._mtx:
             return len(self._peers)
 
+    def send_rate_total(self) -> float:
+        """Aggregate live-peer send rate, bytes/s — the flowrate
+        monitors rolled up for the telemetry gauges."""
+        return sum(p.send_monitor.rate for p in self.peers())
+
+    def recv_rate_total(self) -> float:
+        return sum(p.recv_monitor.rate for p in self.peers())
+
     def add_peer_endpoint(
         self, remote_info: NodeInfo, endpoint: Endpoint, outbound: bool
     ) -> Peer:

@@ -231,6 +231,29 @@ def make_routes(node) -> dict:
             "peers": peers,
         }
 
+    def dump_telemetry(spans: int = 128, prefix: str = "") -> dict:
+        """Structured telemetry dump: the full metrics registry, the
+        recent span window (consensus round phases, device dispatch),
+        and per-service breaker snapshots. The JSON twin of
+        `GET /metrics` (docs/OBSERVABILITY.md)."""
+        from tendermint_tpu.telemetry import REGISTRY, TRACER
+
+        breakers = {}
+        for name, svc in (
+            ("verifier", getattr(node.consensus, "verifier", None)),
+            ("hasher", getattr(node, "hasher", None)),
+        ):
+            if svc is not None and hasattr(svc, "snapshot"):
+                try:
+                    breakers[name] = svc.snapshot()
+                except Exception:
+                    pass
+        return {
+            "metrics": REGISTRY.to_dict(),
+            "spans": TRACER.recent(n=int(spans), prefix=str(prefix)),
+            "breakers": breakers,
+        }
+
     def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
         res = node.app_conns.query.query_sync(
             path, bytes.fromhex(data) if data else b"", int(height), bool(prove)
@@ -493,6 +516,7 @@ def make_routes(node) -> dict:
         "commit": commit,
         "validators": validators,
         "dump_consensus_state": dump_consensus_state,
+        "dump_telemetry": dump_telemetry,
         "abci_query": abci_query,
         "abci_info": abci_info,
         "num_unconfirmed_txs": num_unconfirmed_txs,

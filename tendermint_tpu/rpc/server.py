@@ -103,8 +103,13 @@ def _make_handler(routes: dict, event_switch=None):
             self.wfile.write(body)
 
         def _call(self, req_id, method, params):
+            from tendermint_tpu.telemetry import metrics as _metrics
+
             fn = routes.get(method)
             if fn is None:
+                _metrics.RPC_REQUESTS.labels(
+                    method="<unknown>", result="error"
+                ).inc()
                 return {
                     "jsonrpc": "2.0",
                     "id": req_id,
@@ -112,20 +117,24 @@ def _make_handler(routes: dict, event_switch=None):
                 }
             try:
                 result = fn(**params) if isinstance(params, dict) else fn(*params)
+                _metrics.RPC_REQUESTS.labels(method=method, result="ok").inc()
                 return {"jsonrpc": "2.0", "id": req_id, "result": result}
             except RPCError as e:
+                _metrics.RPC_REQUESTS.labels(method=method, result="error").inc()
                 return {
                     "jsonrpc": "2.0",
                     "id": req_id,
                     "error": {"code": e.code, "message": e.message},
                 }
             except TypeError as e:
+                _metrics.RPC_REQUESTS.labels(method=method, result="error").inc()
                 return {
                     "jsonrpc": "2.0",
                     "id": req_id,
                     "error": {"code": -32602, "message": f"invalid params: {e}"},
                 }
             except Exception as e:
+                _metrics.RPC_REQUESTS.labels(method=method, result="error").inc()
                 return {
                     "jsonrpc": "2.0",
                     "id": req_id,
@@ -171,6 +180,11 @@ def _make_handler(routes: dict, event_switch=None):
             ):
                 self._upgrade_websocket()
                 return
+            if method == "metrics":
+                # Prometheus text exposition — plain HTTP, not JSON-RPC,
+                # so any scraper can point straight at the RPC listener
+                self._serve_metrics()
+                return
             if method == "":
                 # route listing (reference serves an index page)
                 self._respond({"jsonrpc": "2.0", "id": -1, "result": sorted(routes)})
@@ -185,6 +199,18 @@ def _make_handler(routes: dict, event_switch=None):
                 else:
                     params[k] = v.strip('"')
             self._respond(self._call(-1, method, params))
+
+        def _serve_metrics(self):
+            from tendermint_tpu.telemetry import REGISTRY
+            from tendermint_tpu.telemetry import metrics as _metrics
+
+            body = REGISTRY.prometheus_text().encode()
+            _metrics.RPC_REQUESTS.labels(method="metrics", result="ok").inc()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
 
         def _upgrade_websocket(self):
             from tendermint_tpu.rpc.websocket import WSSession, accept_key

@@ -15,10 +15,17 @@ already-hashed-leaf aggregation).
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
 from tendermint_tpu.merkle import simple as host_merkle
+from tendermint_tpu.telemetry import metrics as _metrics
+
+
+def _observe_hash(backend: str, leaves: int, seconds: float) -> None:
+    _metrics.HASH_BATCH_LEAVES.labels(backend=backend).observe(leaves)
+    _metrics.HASH_SECONDS.labels(backend=backend).observe(seconds)
 
 # Below this leaf count the ~60 ms per-launch dispatch floor
 # (docs/PLATFORM_NOTES.md) makes host hashlib strictly faster; the device
@@ -53,14 +60,20 @@ class TreeHasher:
 
     def root_from_items(self, items: list[bytes]) -> bytes:
         """SimpleMerkle root over raw byte leaves (leaf-prefixed hashes)."""
+        t0 = time.perf_counter()
         if self._use_device(len(items)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_device
 
-            return merkle_root_device(items, self.algo)
-        return host_merkle.simple_hash_from_byte_slices(items, self.algo)
+            out = merkle_root_device(items, self.algo)
+            _observe_hash("device", len(items), time.perf_counter() - t0)
+            return out
+        out = host_merkle.simple_hash_from_byte_slices(items, self.algo)
+        _observe_hash("host", len(items), time.perf_counter() - t0)
+        return out
 
     def root_from_hashes(self, hashes: list[bytes]) -> bytes:
         """Root over already-hashed leaves (PartSet/Commit aggregation)."""
+        t0 = time.perf_counter()
         if self._use_device(len(hashes)):
             from tendermint_tpu.ops.merkle_kernel import merkle_root_from_leaf_words
             from tendermint_tpu.ops.padding import (
@@ -80,8 +93,12 @@ class TreeHasher:
                 .reshape(len(hashes), -1)
             )
             root = merkle_root_from_leaf_words(words, algo=self.algo)
-            return to_bytes(np.asarray(root)[None, :])[0]
-        return host_merkle.simple_hash_from_hashes(hashes, self.algo)
+            out = to_bytes(np.asarray(root)[None, :])[0]
+            _observe_hash("device", len(hashes), time.perf_counter() - t0)
+            return out
+        out = host_merkle.simple_hash_from_hashes(hashes, self.algo)
+        _observe_hash("host", len(hashes), time.perf_counter() - t0)
+        return out
 
     def proofs(self, items: list[bytes]):
         """Merkle proofs stay on host: O(N log N) pointer work, tiny data."""

@@ -78,8 +78,12 @@ class _ResilientDispatch:
         self._breaker = breaker or CircuitBreaker(
             failure_threshold=_env_int("TENDERMINT_TPU_BREAKER_THRESHOLD", 3),
             reset_timeout_s=_env_float("TENDERMINT_TPU_BREAKER_RESET_S", 5.0),
-            on_state_change=self._log_transition,
         )
+        # telemetry + transition logs attach to externally supplied
+        # breakers too (chaos tests hand in tuned ones and still expect
+        # the exported trip/recovery counters to move)
+        self._breaker.bind_telemetry(kind)
+        self._breaker.add_state_listener(self._log_transition)
         self._max_retries = (
             _env_int("TENDERMINT_TPU_DEVICE_RETRIES", 1)
             if max_retries is None
@@ -138,6 +142,8 @@ class _ResilientDispatch:
     def call(self, primary_fn, fallback_fn, *args, **kwargs):
         """Route one operation: primary behind the breaker (with retries
         + fault injection + timeout), host fallback otherwise."""
+        from tendermint_tpu.telemetry import metrics
+
         if self._breaker.allow():
             for attempt in range(1 + max(0, self._max_retries)):
                 try:
@@ -145,9 +151,11 @@ class _ResilientDispatch:
                     out = self._run_with_timeout(primary_fn, args, kwargs)
                     self._breaker.record_success()
                     self.primary_calls += 1
+                    metrics.DISPATCH_PRIMARY.labels(kind=self._kind).inc()
                     return out
                 except Exception as e:
                     self._breaker.record_failure()
+                    metrics.DISPATCH_FAILURES.labels(kind=self._kind).inc()
                     kv(
                         _log,
                         logging.WARNING,
@@ -167,6 +175,7 @@ class _ResilientDispatch:
                         continue
                     break
         self.fallback_calls += 1
+        metrics.DISPATCH_FALLBACK.labels(kind=self._kind).inc()
         return fallback_fn(*args, **kwargs)
 
     def snapshot(self) -> dict:

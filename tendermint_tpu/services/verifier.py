@@ -20,11 +20,23 @@ buckets so recompiles stay bounded).
 from __future__ import annotations
 
 import os
+import time
 from typing import Sequence
 
 import numpy as np
 
+from tendermint_tpu.telemetry import metrics as _metrics
+
 Triple = tuple[bytes, bytes, bytes]  # (pubkey32, message, signature64)
+
+
+def _observe_verify(backend: str, n: int, seconds: float) -> None:
+    """One verify call's worth of hot-path telemetry. Each executing
+    backend reports itself, so a resilient host fallback shows up under
+    backend="host" while the failed device attempt stays attributed to
+    the dispatch-failure counters."""
+    _metrics.VERIFY_BATCH_SIZE.labels(backend=backend).observe(n)
+    _metrics.VERIFY_SECONDS.labels(backend=backend).observe(seconds)
 
 
 class BatchVerifier:
@@ -61,11 +73,13 @@ class HostBatchVerifier(BatchVerifier):
     def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
         from tendermint_tpu.crypto.keys import PUBKEY_LEN, PubKey
 
+        t0 = time.perf_counter()
         out = np.zeros(len(triples), dtype=bool)
         for i, (pk, msg, sig) in enumerate(triples):
             if len(pk) != PUBKEY_LEN:
                 continue
             out[i] = PubKey(pk).verify(msg, sig)
+        _observe_verify("host", len(triples), time.perf_counter() - t0)
         return out
 
 
@@ -101,7 +115,16 @@ class DeviceBatchVerifier(BatchVerifier):
         from tendermint_tpu.ops.ed25519_kernel import batch_verify
 
         pubs, msgs, sigs = zip(*triples)
-        return batch_verify(list(pubs), list(msgs), list(sigs))
+        t0 = time.perf_counter()
+        out = batch_verify(list(pubs), list(msgs), list(sigs))
+        _observe_verify("device", len(triples), time.perf_counter() - t0)
+        return out
+
+
+class TableBuildError(RuntimeError):
+    """Comb-table construction is unavailable (device build faulted and
+    the set is too large to host-build); callers answer with host
+    crypto."""
 
 
 class TableBatchVerifier(DeviceBatchVerifier):
@@ -127,10 +150,26 @@ class TableBatchVerifier(DeviceBatchVerifier):
         import threading
         from collections import OrderedDict
 
+        from tendermint_tpu.utils.circuit import CircuitBreaker
+
         # key -> (pubkeys tuple, tables, ok)
         self._tables: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._cache_size = cache_size
         self._cache_lock = threading.RLock()
+        # Table CONSTRUCTION gets its own breaker (ROADMAP open item):
+        # the build kernel is a separate executable from the verify
+        # kernel, so it can be sick on its own — N build faults stop us
+        # dialing the device builder, and small sets degrade to the
+        # compile-free host build while verify stays on device.
+        self._build_breaker = CircuitBreaker(
+            failure_threshold=int(
+                os.environ.get("TENDERMINT_TPU_BREAKER_THRESHOLD", 3)
+            ),
+            reset_timeout_s=float(
+                os.environ.get("TENDERMINT_TPU_BREAKER_RESET_S", 5.0)
+            ),
+            name="tables",
+        )
 
     @staticmethod
     def _cache_key(pubkeys: tuple[bytes, ...]) -> bytes:
@@ -192,21 +231,65 @@ class TableBatchVerifier(DeviceBatchVerifier):
             hit = self._tables.get(key)
             if hit is not None:
                 self._tables.move_to_end(key)
+                _metrics.TABLE_CACHE.labels(event="hit").inc()
                 return hit[1], hit[2]
-        built = self._incremental_build(pubkeys)
-        if built is None:
-            from tendermint_tpu.ops.ed25519_tables import build_key_tables
-
-            pub = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(
-                len(pubkeys), 32
-            )
-            built = build_key_tables(pub)
-        tables, ok = built
+        _metrics.TABLE_CACHE.labels(event="miss").inc()
+        tables, ok = self._build_tables(pubkeys)
         with self._cache_lock:
             self._tables[key] = (tuple(pubkeys), tables, ok)
             while len(self._tables) > self._cache_size:
                 self._tables.popitem(last=False)
         return tables, ok
+
+    def _build_tables(self, pubkeys: tuple[bytes, ...]):
+        """Construct tables for an uncached set, behind the table-build
+        breaker: device build (incremental when a cached set overlaps),
+        degrading to the compile-free host build for sets small enough
+        to afford it (~0.14 s/key), else raising `TableBuildError` so
+        `verify_commits` answers with host crypto."""
+        from tendermint_tpu.utils.fail import device_fail_point
+
+        if self._build_breaker.allow():
+            try:
+                device_fail_point("tables")
+                built = self._incremental_build(pubkeys)
+                if built is not None:
+                    _metrics.TABLE_CACHE.labels(event="incremental").inc()
+                else:
+                    from tendermint_tpu.ops.ed25519_tables import build_key_tables
+
+                    pub = np.frombuffer(b"".join(pubkeys), dtype=np.uint8).reshape(
+                        len(pubkeys), 32
+                    )
+                    built = build_key_tables(pub)
+                self._build_breaker.record_success()
+                return built
+            except Exception as e:
+                self._build_breaker.record_failure()
+                import logging
+
+                from tendermint_tpu.utils.log import kv, logger
+
+                kv(
+                    logger("resilient"),
+                    logging.WARNING,
+                    "table build failed",
+                    n_keys=len(pubkeys),
+                    error=f"{type(e).__name__}: {e}"[:120],
+                    breaker=self._build_breaker.state,
+                )
+        if len(pubkeys) <= self.MAX_INCREMENTAL_KEYS:
+            import jax.numpy as jnp
+
+            from tendermint_tpu.ops.ed25519_tables import host_build_key_tables
+
+            _metrics.TABLE_CACHE.labels(event="host_build").inc()
+            t, ok = host_build_key_tables(list(pubkeys))
+            return jnp.asarray(t), ok
+        raise TableBuildError(
+            f"table build unavailable for {len(pubkeys)} keys "
+            f"(breaker {self._build_breaker.state})"
+        )
 
     def warm_kernels(self) -> None:
         """Background-load the chunked build executable (one dummy
@@ -280,19 +363,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
             return np.zeros((k, n), dtype=bool)
         if k * n < self._min_batch:
             # small commits: host loop beats a device launch
-            out = np.zeros((k, n), dtype=bool)
-            for ci, (msgs, sigs) in enumerate(commits):
-                lanes = [
-                    i
-                    for i in range(n)
-                    if msgs[i] is not None and sigs[i] is not None
-                ]
-                lane_triples = [(pubkeys[i], msgs[i], sigs[i]) for i in lanes]
-                if lane_triples:
-                    verdicts = self._host.verify_batch(lane_triples)
-                    for i, v in zip(lanes, verdicts):
-                        out[ci, i] = v
-            return out
+            return self._host_commit_loop(pubkeys, commits)
         # malformed pubkeys degrade to a False verdict (matching every
         # other backend) instead of corrupting the packed table build
         length_ok = np.array([len(pk) == 32 for pk in pubkeys], dtype=bool)
@@ -301,7 +372,13 @@ class TableBatchVerifier(DeviceBatchVerifier):
             pubkeys = [
                 pk if ok else placeholder for pk, ok in zip(pubkeys, length_ok)
             ]
-        tables, key_ok = self._tables_for(tuple(pubkeys))
+        try:
+            tables, key_ok = self._tables_for(tuple(pubkeys))
+        except TableBuildError:
+            # table construction is down and the set is too big to
+            # host-build: answer this call with host crypto (slow but
+            # correct) instead of raising out of the consensus path
+            return self._host_commit_loop(pubkeys, commits)
         key_ok = key_ok & length_ok
         # The fused pallas path wants K in multiples of 8 (lane planes
         # are (8, 16K)) up to MAX_FUSED_STACK; pad with absent-vote
@@ -323,6 +400,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
         )
         out_rows = []
         chunk = MAX_FUSED_STACK if fusable else len(commits)
+        t0 = time.perf_counter()
         for lo in range(0, k, chunk):
             part = list(commits[lo : lo + chunk])
             real = len(part)
@@ -333,7 +411,26 @@ class TableBatchVerifier(DeviceBatchVerifier):
             out = np.asarray(verify_tables_kernel(tables, s, h, r))
             out = (out & precheck & np.tile(key_ok, len(part))).reshape(-1, n)
             out_rows.append(out[:real])
+        _observe_verify("tables", k * n, time.perf_counter() - t0)
         return np.concatenate(out_rows, axis=0)
+
+    def _host_commit_loop(self, pubkeys, commits) -> np.ndarray:
+        """Sequential host verification of commit-shaped lanes — the
+        small-commit path and the table-build degradation target."""
+        n = len(pubkeys)
+        out = np.zeros((len(commits), n), dtype=bool)
+        for ci, (msgs, sigs) in enumerate(commits):
+            lanes = [
+                i
+                for i in range(n)
+                if msgs[i] is not None and sigs[i] is not None
+            ]
+            lane_triples = [(pubkeys[i], msgs[i], sigs[i]) for i in lanes]
+            if lane_triples:
+                verdicts = self._host.verify_batch(lane_triples)
+                for i, v in zip(lanes, verdicts):
+                    out[ci, i] = v
+        return out
 
 
 _DEFAULT: BatchVerifier | None = None

@@ -1,0 +1,189 @@
+"""The metric catalog: every exported series, registered at import.
+
+One module owns all names so the exposition stays consistent and
+greppable (docs/OBSERVABILITY.md is generated from this list by hand —
+keep them in sync). Naming follows the reference's Prometheus
+conventions (`tendermint_consensus_height`, ...); label values are
+low-cardinality by construction: `backend` ∈ {host, device, tables},
+`kind` ∈ {verify, hash, tables}, `phase` ∈ round phases, never peer ids
+or heights.
+
+Process-global like the registry: a production process runs ONE node,
+so node-scoped gauges (mempool depth, p2p rates) are process gauges.
+Multi-node-in-process harnesses (testing/nemesis.py) see sums across
+nodes for counters — exactly what their invariants want — and
+last-writer-wins for gauges, which they avoid asserting on.
+"""
+
+from __future__ import annotations
+
+from tendermint_tpu.telemetry.registry import (
+    LATENCY_BUCKETS,
+    SIZE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+# -- consensus ----------------------------------------------------------------
+
+CONSENSUS_HEIGHT = Gauge(
+    "tendermint_consensus_height", "Current consensus height"
+)
+CONSENSUS_ROUND = Gauge(
+    "tendermint_consensus_round", "Current consensus round"
+)
+CONSENSUS_PHASE_SECONDS = Histogram(
+    "tendermint_consensus_phase_seconds",
+    "Wall time spent in each round phase (propose/prevote/precommit/commit)",
+    labelnames=("phase",),
+    buckets=LATENCY_BUCKETS,
+)
+CONSENSUS_HEIGHT_SECONDS = Histogram(
+    "tendermint_consensus_height_seconds",
+    "Wall time from entering a height to finalizing its commit",
+    buckets=LATENCY_BUCKETS,
+)
+CONSENSUS_COMMITS = Counter(
+    "tendermint_consensus_commits_total", "Blocks finalized by this node"
+)
+CONSENSUS_TXS_COMMITTED = Counter(
+    "tendermint_consensus_txs_committed_total", "Txs in blocks finalized by this node"
+)
+CONSENSUS_ROUND_SKIPS = Counter(
+    "tendermint_consensus_round_skips_total",
+    "Round-skip timeouts fired while starved at PREVOTE/PRECOMMIT",
+    labelnames=("phase",),
+)
+VOTE_DRAIN_BATCH = Histogram(
+    "tendermint_consensus_vote_drain_batch_size",
+    "Consecutive same-(height,round,type) votes drained per receive-loop turn",
+    buckets=SIZE_BUCKETS,
+)
+
+# -- device dispatch (verify / hash hot paths) --------------------------------
+
+VERIFY_BATCH_SIZE = Histogram(
+    "tendermint_verify_batch_size",
+    "ed25519 signatures per verify call, by executing backend",
+    labelnames=("backend",),
+    buckets=SIZE_BUCKETS,
+)
+VERIFY_SECONDS = Histogram(
+    "tendermint_verify_seconds",
+    "ed25519 verify call latency, by executing backend",
+    labelnames=("backend",),
+    buckets=LATENCY_BUCKETS,
+)
+HASH_BATCH_LEAVES = Histogram(
+    "tendermint_hash_batch_leaves",
+    "Merkle leaves per root build, by executing backend",
+    labelnames=("backend",),
+    buckets=SIZE_BUCKETS,
+)
+HASH_SECONDS = Histogram(
+    "tendermint_hash_seconds",
+    "Merkle root build latency, by executing backend",
+    labelnames=("backend",),
+    buckets=LATENCY_BUCKETS,
+)
+TABLE_CACHE = Counter(
+    "tendermint_verify_table_cache_total",
+    "Valset comb-table cache outcomes (hit/miss/incremental/host_fallback)",
+    labelnames=("event",),
+)
+XLA_CACHE_ENABLED = Gauge(
+    "tendermint_xla_persistent_cache_enabled",
+    "1 when the persistent XLA executable cache is active",
+)
+
+# -- resilient dispatch / circuit breaker -------------------------------------
+
+BREAKER_STATE = Gauge(
+    "tendermint_breaker_state",
+    "Circuit breaker state (0=closed, 1=half_open, 2=open)",
+    labelnames=("kind",),
+)
+BREAKER_TRANSITIONS = Counter(
+    "tendermint_breaker_transitions_total",
+    "Breaker state transitions; to=open counts trips, to=closed recoveries",
+    labelnames=("kind", "to"),
+)
+DISPATCH_PRIMARY = Counter(
+    "tendermint_device_primary_calls_total",
+    "Calls answered by the primary (device) backend",
+    labelnames=("kind",),
+)
+DISPATCH_FALLBACK = Counter(
+    "tendermint_device_fallback_calls_total",
+    "Calls degraded to the host fallback",
+    labelnames=("kind",),
+)
+DISPATCH_FAILURES = Counter(
+    "tendermint_device_dispatch_failures_total",
+    "Primary dispatch attempts that raised (pre-retry granularity)",
+    labelnames=("kind",),
+)
+
+# Pre-seed the known breaker kinds and round-skip phases so scrapes see
+# zero-valued series before (or without) any instance/event — Prometheus
+# convention: known label values start at 0, absence means "unknown".
+for _kind in ("verify", "hash", "tables"):
+    BREAKER_STATE.labels(kind=_kind).set(0)
+for _phase in ("prevote", "precommit"):
+    CONSENSUS_ROUND_SKIPS.labels(phase=_phase).inc(0)
+
+# -- p2p ----------------------------------------------------------------------
+
+P2P_SENT_BYTES = Counter(
+    "tendermint_p2p_sent_bytes_total", "Frame bytes sent to peers"
+)
+P2P_RECV_BYTES = Counter(
+    "tendermint_p2p_recv_bytes_total", "Frame bytes received from peers"
+)
+P2P_PEERS = Gauge("tendermint_p2p_peers", "Connected peers")
+P2P_SEND_RATE = Gauge(
+    "tendermint_p2p_send_rate_bytes", "Aggregate send rate over live peers, bytes/s"
+)
+P2P_RECV_RATE = Gauge(
+    "tendermint_p2p_recv_rate_bytes", "Aggregate recv rate over live peers, bytes/s"
+)
+
+# -- mempool ------------------------------------------------------------------
+
+MEMPOOL_SIZE = Gauge("tendermint_mempool_size", "Pending txs in the mempool")
+MEMPOOL_TXS = Counter(
+    "tendermint_mempool_txs_total",
+    "CheckTx outcomes (ok/rejected/duplicate)",
+    labelnames=("result",),
+)
+
+# -- consensus WAL ------------------------------------------------------------
+
+WAL_FSYNC_SECONDS = Histogram(
+    "tendermint_wal_fsync_seconds",
+    "Consensus WAL write+fsync latency per record",
+    buckets=LATENCY_BUCKETS,
+)
+WAL_WRITTEN_BYTES = Counter(
+    "tendermint_wal_written_bytes_total", "Framed bytes appended to the consensus WAL"
+)
+
+# -- rpc ----------------------------------------------------------------------
+
+RPC_REQUESTS = Counter(
+    "tendermint_rpc_requests_total",
+    "RPC calls served, by method and outcome",
+    labelnames=("method", "result"),
+)
+
+
+def bind_node_gauges(node) -> None:
+    """Point the live-view gauges at a composed `node.Node`. Called from
+    the node's start(); the callbacks read cheap in-memory state at
+    scrape time only."""
+
+    P2P_PEERS.set_function(lambda: node.switch.n_peers() if node.switch else 0)
+    P2P_SEND_RATE.set_function(lambda: node.switch.send_rate_total())
+    P2P_RECV_RATE.set_function(lambda: node.switch.recv_rate_total())
+    MEMPOOL_SIZE.set_function(lambda: node.mempool.size())

@@ -1,0 +1,88 @@
+"""Lightweight span tracer for consensus/device-path timelines.
+
+Not a distributed tracer — a bounded in-process ring of completed spans
+(name, wall-clock start/end, attributes) cheap enough to leave on in
+production. Consensus records one span per round phase
+(`consensus.propose` → `consensus.commit`, attributed with
+height/round), device dispatch records verify/hash batches; the
+`dump_telemetry` RPC serves the recent window so a stalled height can
+be read as a timeline instead of reverse-engineered from logs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    name: str
+    start: float  # time.time() epoch seconds
+    end: float
+    attrs: dict = field(default_factory=dict)
+
+    @property
+    def duration(self) -> float:
+        return self.end - self.start
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "start": self.start,
+            "end": self.end,
+            "duration_s": self.duration,
+            **({"attrs": self.attrs} if self.attrs else {}),
+        }
+
+
+class Tracer:
+    """Bounded ring of completed spans; thread-safe."""
+
+    def __init__(self, capacity: int = 1024) -> None:
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(maxlen=capacity)
+
+    def add(self, name: str, start: float, end: float, **attrs) -> Span:
+        span = Span(name, start, end, attrs)
+        with self._lock:
+            self._spans.append(span)
+        return span
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """`with TRACER.span("verify.batch", n=512): ...` — the span is
+        recorded on exit, errors included (attr `error` is set)."""
+        t0 = time.time()
+        try:
+            yield attrs  # callers may add attrs mid-span
+        except BaseException as e:
+            attrs["error"] = f"{type(e).__name__}"
+            raise
+        finally:
+            self.add(name, t0, time.time(), **attrs)
+
+    def recent(self, n: int | None = None, prefix: str = "") -> list[dict]:
+        with self._lock:
+            spans = list(self._spans)
+        if prefix:
+            spans = [s for s in spans if s.name.startswith(prefix)]
+        if n is not None:
+            spans = spans[-n:]
+        return [s.to_dict() for s in spans]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+# Process-wide tracer, sized for ~2 minutes of 4-phase consensus at
+# test speed plus device-path spans.
+TRACER = Tracer(capacity=1024)

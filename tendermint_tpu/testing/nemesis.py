@@ -31,6 +31,12 @@ Fault primitives compose (Jepsen-nemesis style, hence the name):
   `set_device_fault`) — trips the resilient-dispatch circuit breaker
   (`services/resilient.py`) mid-height; the invariants then prove the
   host-fallback keeps both safety AND liveness.
+
+Degradation cycles are asserted on the EXPORTED telemetry
+(`breaker_baseline` / `assert_breaker_tripped` /
+`assert_breaker_recovered`, plus `wait_telemetry_above` for counters
+like round skips): what an operator's dashboard would show is what the
+chaos suite checks (docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -404,6 +410,95 @@ class Nemesis:
             tail = f.read(n)
             f.seek(size - n)
             f.write(bytes(b ^ 0xFF for b in tail))
+
+    # -- telemetry invariants ------------------------------------------------
+    #
+    # Chaos assertions on the EXPORTED numbers, not harness internals:
+    # what an operator's dashboard would show is what the invariant
+    # checks. Counters are process-global (telemetry/metrics.py), so in
+    # this multi-node-per-process harness they sum across nodes —
+    # baselines make the deltas per-scenario.
+
+    @staticmethod
+    def telemetry_value(name: str, **labels) -> float:
+        """Current value of an exported counter/gauge series (0 when the
+        series has never been touched)."""
+        from tendermint_tpu.telemetry import REGISTRY
+
+        return REGISTRY.counter_value(name, **labels)
+
+    def breaker_baseline(self, kind: str = "verify") -> dict:
+        """Snapshot the breaker telemetry before injecting a fault; pass
+        to `assert_breaker_tripped` / `assert_breaker_recovered`."""
+        return {
+            "kind": kind,
+            "trips": self.telemetry_value(
+                "tendermint_breaker_transitions_total", kind=kind, to="open"
+            ),
+            "recoveries": self.telemetry_value(
+                "tendermint_breaker_transitions_total", kind=kind, to="closed"
+            ),
+            "fallbacks": self.telemetry_value(
+                "tendermint_device_fallback_calls_total", kind=kind
+            ),
+        }
+
+    def assert_breaker_tripped(self, baseline: dict, min_trips: int = 1) -> None:
+        kind = baseline["kind"]
+        trips = (
+            self.telemetry_value(
+                "tendermint_breaker_transitions_total", kind=kind, to="open"
+            )
+            - baseline["trips"]
+        )
+        fallbacks = (
+            self.telemetry_value(
+                "tendermint_device_fallback_calls_total", kind=kind
+            )
+            - baseline["fallbacks"]
+        )
+        if trips < min_trips:
+            raise InvariantViolation(
+                f"breaker[{kind}]: expected >= {min_trips} trips via telemetry, saw {trips}"
+            )
+        if fallbacks <= 0:
+            raise InvariantViolation(
+                f"breaker[{kind}]: tripped but no fallback calls exported"
+            )
+
+    def assert_breaker_recovered(
+        self, baseline: dict, min_recoveries: int = 1
+    ) -> None:
+        kind = baseline["kind"]
+        recoveries = (
+            self.telemetry_value(
+                "tendermint_breaker_transitions_total", kind=kind, to="closed"
+            )
+            - baseline["recoveries"]
+        )
+        if recoveries < min_recoveries:
+            raise InvariantViolation(
+                f"breaker[{kind}]: expected >= {min_recoveries} recoveries "
+                f"via telemetry, saw {recoveries}"
+            )
+
+    def wait_telemetry_above(
+        self, name: str, threshold: float, timeout: float = 30.0, **labels
+    ) -> float:
+        """Block until an exported series exceeds `threshold` (e.g. the
+        round-skip counter during a starvation scenario)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.violations:
+                raise InvariantViolation(self.violations[0])
+            v = self.telemetry_value(name, **labels)
+            if v > threshold:
+                return v
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"{name}{labels or ''} stayed <= {threshold} for {timeout}s "
+            f"(now {self.telemetry_value(name, **labels)})"
+        )
 
     # -- invariants ----------------------------------------------------------
 

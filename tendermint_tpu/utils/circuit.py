@@ -21,6 +21,9 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+# numeric encoding for the telemetry gauge (ordered by "badness")
+STATE_CODES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
 
 class CircuitBreaker:
     def __init__(
@@ -29,13 +32,14 @@ class CircuitBreaker:
         reset_timeout_s: float = 5.0,
         clock=time.monotonic,
         on_state_change=None,
+        name: str | None = None,
     ) -> None:
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.failure_threshold = failure_threshold
         self.reset_timeout_s = reset_timeout_s
         self._clock = clock
-        self._on_state_change = on_state_change
+        self._listeners = [on_state_change] if on_state_change is not None else []
         self._mtx = threading.RLock()
         self._state = CLOSED
         self._consecutive_failures = 0
@@ -45,6 +49,32 @@ class CircuitBreaker:
         self.total_failures = 0
         self.total_successes = 0
         self.times_opened = 0
+        self.name: str | None = None
+        if name is not None:
+            self.bind_telemetry(name)
+
+    def add_state_listener(self, fn) -> None:
+        """fn(old, new) on every transition; listeners run under the
+        breaker lock, so keep them cheap (log lines, counter bumps)."""
+        self._listeners.append(fn)
+
+    def bind_telemetry(self, name: str) -> None:
+        """Export this breaker under `kind=name`: a state gauge plus
+        transition counters (to=open counts trips, to=closed counts
+        recoveries) — the exporter hook `snapshot()` always promised.
+        Idempotent; the first name wins."""
+        if self.name is not None:
+            return
+        self.name = name
+        from tendermint_tpu.telemetry import metrics
+
+        metrics.BREAKER_STATE.labels(kind=name).set(STATE_CODES[self._state])
+
+        def _export(old: str, new: str) -> None:
+            metrics.BREAKER_STATE.labels(kind=name).set(STATE_CODES[new])
+            metrics.BREAKER_TRANSITIONS.labels(kind=name, to=new).inc()
+
+        self._listeners.append(_export)
 
     @property
     def state(self) -> str:
@@ -54,8 +84,9 @@ class CircuitBreaker:
 
     def _set_state(self, new: str) -> None:
         old, self._state = self._state, new
-        if old != new and self._on_state_change is not None:
-            self._on_state_change(old, new)
+        if old != new:
+            for fn in self._listeners:
+                fn(old, new)
 
     def _maybe_half_open(self) -> None:
         if (
@@ -105,7 +136,8 @@ class CircuitBreaker:
                 self._set_state(OPEN)
 
     def snapshot(self) -> dict:
-        """Degradation state for logs/metrics exporters."""
+        """Degradation state for logs/metrics exporters (the same
+        numbers `bind_telemetry` streams into the registry)."""
         with self._mtx:
             self._maybe_half_open()
             return {

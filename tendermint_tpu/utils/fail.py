@@ -51,7 +51,8 @@ def reset_for_testing() -> None:
 # calls (batch verify, device merkle) to raise deterministically so the
 # resilient-dispatch layer (`services/resilient.py`) can be driven
 # through its degrade→probe→recover cycle in tests. Selected by the
-# TENDERMINT_TPU_DEVICE_FAIL env var — "verify", "hash", "all", with an
+# TENDERMINT_TPU_DEVICE_FAIL env var — "verify", "hash", "tables"
+# (valset comb-table construction), "all", with an
 # optional per-kind budget: "verify:3" fails the first 3 verify
 # dispatches then clears; comma-separate for multiple kinds — or at
 # runtime via set_device_fault()/clear_device_faults().
@@ -78,8 +79,8 @@ def _load_device_faults() -> dict[str, int]:
 
 
 def set_device_fault(kind: str, count: int = -1) -> None:
-    """Arm fault injection for `kind` ("verify"/"hash"/"all"); `count`
-    dispatches fail (-1 = until cleared)."""
+    """Arm fault injection for `kind` ("verify"/"hash"/"tables"/"all");
+    `count` dispatches fail (-1 = until cleared)."""
     _load_device_faults()[kind] = count
 
 

@@ -53,4 +53,7 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _enabled = True
+    from tendermint_tpu.telemetry import metrics
+
+    metrics.XLA_CACHE_ENABLED.set(1)
     return path

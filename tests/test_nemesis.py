@@ -91,11 +91,15 @@ class TestDeviceFaultDegradation:
         """THE acceptance scenario: env-forced verifier device faults on
         a running network -> breakers trip OPEN -> blocks keep
         committing on the host fallback (no fork, height progress) ->
-        fault clears -> breakers re-close."""
+        fault clears -> breakers re-close. The degradation cycle is
+        asserted through the EXPORTED telemetry (trip/recovery counters,
+        fallback calls), not harness internals — what a dashboard would
+        show is what the invariant checks."""
         with Nemesis(
             4, home=str(tmp_path), verifier_factory=_resilient_factory()
         ) as net:
             net.wait_height(2, timeout=60)
+            base = net.breaker_baseline("verify")
 
             fail.set_device_fault("verify")  # device 'dies' mid-consensus
             net.wait_progress(delta=2, timeout=60)  # liveness on fallback
@@ -103,10 +107,9 @@ class TestDeviceFaultDegradation:
             # probes keep failing while the fault is armed)
             tripped = [n.cs.verifier.breaker.state for n in net.nodes]
             assert all(s != "closed" for s in tripped), tripped
-            assert all(n.cs.verifier.breaker.times_opened > 0 for n in net.nodes)
-            assert all(
-                n.cs.verifier.snapshot()["fallback_calls"] > 0 for n in net.nodes
-            )
+            # ... and the degradation is observable from telemetry alone:
+            # all 4 nodes' breakers tripped, fallbacks answered calls
+            net.assert_breaker_tripped(base, min_trips=len(net.nodes))
             net.check_invariants()  # safety on fallback (no fork)
 
             fail.clear_device_faults()  # device 'recovers'
@@ -120,7 +123,51 @@ class TestDeviceFaultDegradation:
                 time.sleep(0.1)
             states = [n.cs.verifier.breaker.state for n in net.nodes]
             assert all(s == "closed" for s in states), states
+            net.assert_breaker_recovered(base, min_recoveries=len(net.nodes))
             net.wait_progress(delta=1, timeout=60)  # still live re-upgraded
+
+
+class TestRoundSkip:
+    def test_starved_node_round_skips_and_rejoins(self, tmp_path):
+        """The ROADMAP liveness gap, closed: a node cut off from all
+        vote gossip (total starvation at PREVOTE/PRECOMMIT — no +2/3-any
+        ever arrives to arm the *_wait timeouts) must keep cycling
+        rounds via the round-skip timeout instead of wedging, and the
+        skips are exported so chaos runs can assert on them."""
+        from tendermint_tpu.testing.nemesis import NemesisNode
+
+        cfg = NemesisNode.default_config()
+        cfg.timeout_round_skip = 400  # fast skips for the test
+        cfg.timeout_round_skip_delta = 50
+        with Nemesis(4, home=str(tmp_path), config=cfg) as net:
+            net.wait_height(2, timeout=60)
+            skips_pv = net.telemetry_value(
+                "tendermint_consensus_round_skips_total", phase="prevote"
+            )
+            skips_pc = net.telemetry_value(
+                "tendermint_consensus_round_skips_total", phase="precommit"
+            )
+            net.partition({0, 1, 2}, {3})  # node 3 fully starved
+            # the majority keeps committing; the starved node skips at
+            # PREVOTE (precommit nil) and then at PRECOMMIT (next round)
+            net.wait_telemetry_above(
+                "tendermint_consensus_round_skips_total",
+                skips_pv,
+                timeout=30,
+                phase="prevote",
+            )
+            net.wait_telemetry_above(
+                "tendermint_consensus_round_skips_total",
+                skips_pc,
+                timeout=30,
+                phase="precommit",
+            )
+            net.wait_progress(delta=1, nodes=[0, 1, 2], timeout=60)
+            assert net.nodes[3].cs.round > 0  # it cycled rounds, not wedged
+            net.heal()
+            # safety held and the skipper rejoins the chain after heal
+            target = max(net.heights()) + 1
+            net.wait_height(target, timeout=60)
 
 
 class TestPartitionHeal:

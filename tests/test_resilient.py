@@ -286,3 +286,81 @@ class TestFaultSpecParsing:
         fail.clear_device_faults()
         v2 = verifier_mod.default_verifier()
         assert isinstance(v2, HostBatchVerifier)  # CPU, no faults armed
+
+
+class TestTableBuildBreaker:
+    """The table-CONSTRUCTION path behind its own breaker (ROADMAP open
+    item): a build fault must degrade — small sets host-build their
+    tables, large sets answer with host crypto — never raise out of
+    verify_commits. (The device verify kernel itself is exercised in the
+    kernel-marked suites; these tests stay on the degradation paths.)"""
+
+    def _commit_shape(self, n, corrupt=()):
+        triples = _triples(n, corrupt=corrupt)
+        pubs = [t[0] for t in triples]
+        return pubs, [([t[1] for t in triples], [t[2] for t in triples])]
+
+    def test_build_fault_host_builds_small_sets(self):
+        from tendermint_tpu.services.verifier import TableBatchVerifier
+
+        tv = TableBatchVerifier(min_device_batch=1)
+        pubs, _ = self._commit_shape(3)
+        fail.set_device_fault("tables", 1)
+        tables, ok = tv._build_tables(tuple(pubs))  # degrades, no raise
+        assert ok.all() and tables is not None
+        snap = tv._build_breaker.snapshot()
+        assert snap["total_failures"] == 1
+        assert snap["state"] == CLOSED  # one fault < threshold
+
+    def test_build_fault_on_large_set_degrades_to_host_crypto(self):
+        from tendermint_tpu.services.verifier import TableBatchVerifier
+
+        tv = TableBatchVerifier(min_device_batch=1)
+        tv.MAX_INCREMENTAL_KEYS = 0  # every set counts as "too large"
+        fail.set_device_fault("tables")  # forever, until cleared
+        pubs, commits = self._commit_shape(3, corrupt=(1,))
+        out = tv.verify_commits(pubs, commits)  # must not raise
+        assert out.shape == (1, 3)
+        assert bool(out[0, 0]) and not bool(out[0, 1]) and bool(out[0, 2])
+
+    def test_open_build_breaker_stops_dialing_device_builds(self):
+        from tendermint_tpu.services.verifier import (
+            TableBatchVerifier,
+            TableBuildError,
+        )
+
+        tv = TableBatchVerifier(min_device_batch=1)
+        tv.MAX_INCREMENTAL_KEYS = 0
+        tv._build_breaker = CircuitBreaker(
+            failure_threshold=2, reset_timeout_s=60, name=None
+        )
+        fail.set_device_fault("tables")
+        pubs, commits = self._commit_shape(2)
+        tv.verify_commits(pubs, commits)
+        tv.verify_commits(pubs, commits)
+        assert tv._build_breaker.state == OPEN
+        fail.clear_device_faults()
+        # breaker OPEN: the device builder is not dialed at all, the
+        # degradation answers immediately
+        with pytest.raises(TableBuildError):
+            tv._build_tables(tuple(pubs))
+        out = tv.verify_commits(pubs, commits)  # still answers via host
+        assert out.all()
+
+    def test_table_build_telemetry_counters(self):
+        from tendermint_tpu.services.verifier import TableBatchVerifier
+        from tendermint_tpu.telemetry import REGISTRY
+
+        tv = TableBatchVerifier(min_device_batch=1)
+        before = REGISTRY.counter_value(
+            "tendermint_verify_table_cache_total", event="host_build"
+        )
+        pubs, _ = self._commit_shape(2)
+        fail.set_device_fault("tables", 1)
+        tv._build_tables(tuple(pubs))
+        assert (
+            REGISTRY.counter_value(
+                "tendermint_verify_table_cache_total", event="host_build"
+            )
+            == before + 1
+        )

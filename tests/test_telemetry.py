@@ -1,0 +1,348 @@
+"""Telemetry subsystem: registry semantics (concurrency, histogram
+bucket math, Prometheus golden exposition), the span tracer, the
+`GET /metrics` route, and the registry-driven hot-path bench tool.
+
+End-to-end coverage against a full running node (consensus phase
+histograms moving, breaker series, `dump_telemetry`) lives in
+`tests/test_telemetry_node.py` with the other node-composition suites.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.telemetry import REGISTRY, TRACER
+from tendermint_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from tendermint_tpu.telemetry.tracer import Tracer
+
+
+class TestCountersAndGauges:
+    def test_counter_basics(self):
+        reg = Registry()
+        c = Counter("t_total", "help", registry=reg)
+        assert c.value == 0.0
+        c.inc()
+        c.inc(2.5)
+        assert c.value == 3.5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+
+    def test_labeled_counter_children(self):
+        reg = Registry()
+        c = Counter("t_total", "", labelnames=("kind",), registry=reg)
+        c.labels(kind="a").inc()
+        c.labels("a").inc()  # positional == keyword
+        c.labels(kind="b").inc(5)
+        assert reg.counter_value("t_total", kind="a") == 2.0
+        assert reg.counter_value("t_total", kind="b") == 5.0
+        assert reg.counter_value("t_total", kind="never") == 0.0
+        with pytest.raises(ValueError):
+            c.inc()  # labeled family has no default child
+        with pytest.raises(ValueError):
+            c.labels("a", "b")  # wrong arity
+
+    def test_duplicate_registration_rejected(self):
+        reg = Registry()
+        Counter("dup", "", registry=reg)
+        with pytest.raises(ValueError):
+            Counter("dup", "", registry=reg)
+
+    def test_gauge_set_inc_dec(self):
+        reg = Registry()
+        g = Gauge("g", "", registry=reg)
+        g.set(7)
+        g.inc(3)
+        g.dec()
+        assert g.value == 9.0
+
+    def test_gauge_callback_wins_and_survives_errors(self):
+        reg = Registry()
+        g = Gauge("g", "", registry=reg)
+        g.set(1)
+        g.set_function(lambda: 42)
+        assert g.value == 42.0
+        boom = {"on": False}
+
+        def fn():
+            if boom["on"]:
+                raise RuntimeError("source gone")
+            return 13
+
+        g.set_function(fn)
+        assert g.value == 13.0
+        boom["on"] = True
+        # a dead source keeps the last good value, never breaks a scrape
+        assert g.value == 13.0
+        assert "g 13" in reg.prometheus_text()
+
+
+class TestHistogram:
+    def test_bucket_math(self):
+        reg = Registry()
+        h = Histogram("h", "", buckets=(1, 5, 10), registry=reg)
+        for v in (0.5, 1.0, 3.0, 7.0, 100.0):
+            h.observe(v)
+        snap = h.value
+        assert snap["count"] == 5
+        assert snap["sum"] == pytest.approx(111.5)
+        # cumulative: <=1 gets 0.5 and 1.0; <=5 adds 3.0; <=10 adds 7.0
+        assert snap["buckets"] == [
+            (1.0, 2),
+            (5.0, 3),
+            (10.0, 4),
+            (math.inf, 5),
+        ]
+
+    def test_buckets_are_sorted_on_registration(self):
+        reg = Registry()
+        h = Histogram("h", "", buckets=(10, 1, 5), registry=reg)
+        assert [b for b, _ in h.value["buckets"]] == [1.0, 5.0, 10.0, math.inf]
+
+    def test_quantile_interpolation(self):
+        reg = Registry()
+        h = Histogram("h", "", buckets=(1, 2, 4), registry=reg)
+        for _ in range(50):
+            h.observe(0.5)
+        for _ in range(50):
+            h.observe(3.0)
+        # p50 falls at the boundary of the first bucket
+        assert h.quantile(0.5) == pytest.approx(1.0)
+        # p99 interpolates inside (2, 4]
+        assert 2.0 < h.quantile(0.99) <= 4.0
+        empty = Histogram("h2", "", buckets=(1,), registry=reg)
+        assert math.isnan(empty.quantile(0.5))
+
+    def test_labeled_histogram(self):
+        reg = Registry()
+        h = Histogram("h", "", labelnames=("backend",), buckets=(1,), registry=reg)
+        h.labels(backend="host").observe(0.5)
+        h.labels(backend="host").observe(2.0)
+        assert h.labels(backend="host").value["count"] == 2
+        assert h.labels(backend="device").value["count"] == 0
+
+
+class TestConcurrency:
+    def test_counter_under_threads_is_exact(self):
+        reg = Registry()
+        c = Counter("c_total", "", labelnames=("k",), registry=reg)
+        h = Histogram("lat", "", buckets=(0.5, 1.0), registry=reg)
+        n_threads, per_thread = 8, 5_000
+
+        def hammer(i):
+            child = c.labels(k=str(i % 2))
+            for _ in range(per_thread):
+                child.inc()
+                h.observe(0.25)
+
+        threads = [
+            threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = reg.counter_value("c_total", k="0") + reg.counter_value(
+            "c_total", k="1"
+        )
+        assert total == n_threads * per_thread
+        assert h.value["count"] == n_threads * per_thread
+
+
+class TestPrometheusExposition:
+    def test_golden_output(self):
+        reg = Registry()
+        c = Counter("a_total", "counts things", labelnames=("kind",), registry=reg)
+        g = Gauge("b", "a gauge", registry=reg)
+        h = Histogram("lat_seconds", "latency", buckets=(0.5, 1.0), registry=reg)
+        c.labels(kind="x").inc(3)
+        g.set(1.5)
+        h.observe(0.25)
+        h.observe(0.75)
+        assert reg.prometheus_text() == (
+            "# HELP a_total counts things\n"
+            "# TYPE a_total counter\n"
+            'a_total{kind="x"} 3\n'
+            "# HELP b a gauge\n"
+            "# TYPE b gauge\n"
+            "b 1.5\n"
+            "# HELP lat_seconds latency\n"
+            "# TYPE lat_seconds histogram\n"
+            'lat_seconds_bucket{le="0.5"} 1\n'
+            'lat_seconds_bucket{le="1"} 2\n'
+            'lat_seconds_bucket{le="+Inf"} 2\n'
+            "lat_seconds_sum 1\n"
+            "lat_seconds_count 2\n"
+        )
+
+    def test_label_and_help_escaping(self):
+        reg = Registry()
+        c = Counter("e_total", 'has "quotes"\nand newline', labelnames=("v",), registry=reg)
+        c.labels(v='a"b\\c\nd').inc()
+        text = reg.prometheus_text()
+        assert '# HELP e_total has "quotes"\\nand newline\n' in text
+        assert 'e_total{v="a\\"b\\\\c\\nd"} 1\n' in text
+
+    def test_unlabeled_families_expose_zero_samples(self):
+        reg = Registry()
+        Counter("idle_total", "", registry=reg)
+        Histogram("idle_seconds", "", buckets=(1,), registry=reg)
+        text = reg.prometheus_text()
+        assert "idle_total 0\n" in text
+        assert "idle_seconds_count 0\n" in text
+
+    def test_to_dict_round_trips_through_json(self):
+        reg = Registry()
+        h = Histogram("h", "", buckets=(1,), registry=reg)
+        h.observe(0.5)
+        d = json.loads(json.dumps(reg.to_dict()))
+        assert d["h"]["type"] == "histogram"
+        assert d["h"]["series"][0]["count"] == 1
+        assert d["h"]["series"][0]["buckets"][-1][0] == "+Inf"
+
+
+class TestTracer:
+    def test_span_context_manager_records(self):
+        tr = Tracer(capacity=8)
+        with tr.span("unit.work", n=3):
+            pass
+        spans = tr.recent()
+        assert len(spans) == 1
+        assert spans[0]["name"] == "unit.work"
+        assert spans[0]["attrs"]["n"] == 3
+        assert spans[0]["duration_s"] >= 0
+
+    def test_span_records_errors(self):
+        tr = Tracer(capacity=8)
+        with pytest.raises(RuntimeError):
+            with tr.span("unit.fail"):
+                raise RuntimeError("boom")
+        assert tr.recent()[0]["attrs"]["error"] == "RuntimeError"
+
+    def test_ring_capacity_and_prefix_filter(self):
+        tr = Tracer(capacity=4)
+        for i in range(10):
+            tr.add(f"a.{i % 2}", 0.0, 1.0, i=i)
+        assert len(tr) == 4
+        assert all(s["name"].startswith("a.") for s in tr.recent(prefix="a."))
+        assert tr.recent(n=2)[-1]["attrs"]["i"] == 9
+
+
+class TestCatalog:
+    def test_global_catalog_registered(self):
+        # the catalog module must have registered every advertised family
+        from tendermint_tpu.telemetry import metrics  # noqa: F401
+
+        for name in (
+            "tendermint_consensus_height",
+            "tendermint_consensus_phase_seconds",
+            "tendermint_consensus_round_skips_total",
+            "tendermint_consensus_vote_drain_batch_size",
+            "tendermint_verify_batch_size",
+            "tendermint_hash_seconds",
+            "tendermint_breaker_state",
+            "tendermint_breaker_transitions_total",
+            "tendermint_p2p_sent_bytes_total",
+            "tendermint_mempool_size",
+            "tendermint_wal_fsync_seconds",
+        ):
+            assert REGISTRY.get(name) is not None, name
+
+    def test_breaker_binds_telemetry(self):
+        from tendermint_tpu.utils.circuit import CircuitBreaker
+
+        before = REGISTRY.counter_value(
+            "tendermint_breaker_transitions_total", kind="t-unit", to="open"
+        )
+        b = CircuitBreaker(failure_threshold=2, name="t-unit")
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "open"
+        assert REGISTRY.counter_value(
+            "tendermint_breaker_state", kind="t-unit"
+        ) == 2.0
+        assert (
+            REGISTRY.counter_value(
+                "tendermint_breaker_transitions_total", kind="t-unit", to="open"
+            )
+            == before + 1
+        )
+
+
+class TestMetricsRoute:
+    def test_get_metrics_serves_prometheus_text(self):
+        from tendermint_tpu.rpc.server import RPCServer
+
+        srv = RPCServer({"echo": lambda: {"ok": True}}, "tcp://127.0.0.1:0")
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+            # global registry families render, HELP/TYPE lines included
+            assert "# TYPE tendermint_consensus_height gauge" in body
+            assert "# TYPE tendermint_verify_seconds histogram" in body
+            assert "tendermint_p2p_sent_bytes_total" in body
+            # the scrape itself is counted
+            assert REGISTRY.counter_value(
+                "tendermint_rpc_requests_total", method="metrics", result="ok"
+            ) >= 1
+            # JSON-RPC routes still work beside the exposition route
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/echo", timeout=10
+            ) as resp:
+                assert json.load(resp)["result"] == {"ok": True}
+        finally:
+            srv.stop()
+
+
+class TestBenchHotpath:
+    def test_emits_bench_json_from_registry(self, tmp_path):
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "bench_hotpath",
+            os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "tools",
+                "bench_hotpath.py",
+            ),
+        )
+        bh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bh)
+
+        out = tmp_path / "BENCH_hotpath.json"
+        rc = bh.main(
+            [
+                "--out",
+                str(out),
+                "--reps",
+                "1",
+                "--sizes",
+                "8,16",
+                "--wal-records",
+                "16",
+                "--no-device",
+            ]
+        )
+        assert rc == 0
+        data = json.loads(out.read_text())
+        assert data["unit"] == "verifies/s"
+        assert data["value"] > 0
+        host = data["detail"]["verify"]["host"]
+        assert host["signatures"] >= 8 + 16  # this run's (registry may hold more)
+        assert data["detail"]["wal_fsync"]["count"] >= 16
+        assert data["detail"]["hash"]["host"]["leaves_per_s"] > 0

@@ -1,0 +1,133 @@
+"""Telemetry end-to-end against a running node: `GET /metrics` scrape
+contents (the ISSUE's acceptance surface) and the `dump_telemetry` RPC.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cmd import main as cli_main
+from tendermint_tpu.config import Config
+from tendermint_tpu.node import Node
+from tendermint_tpu.services.resilient import ResilientVerifier
+from tendermint_tpu.services.verifier import HostBatchVerifier
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def solo_node(tmp_path):
+    home = str(tmp_path / "solo")
+    cli_main(["init", "--home", home, "--chain-id", "telemetry-test"])
+    cfg = Config.test_config(home)
+    cfg.base.fast_sync = False
+    # resilient wrapper on host so breaker series exist on CPU CI
+    node = Node(cfg, verifier=ResilientVerifier(HostBatchVerifier()))
+    node.start()
+    yield node
+    node.stop()
+
+
+def _rpc(port, method, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        out = json.load(resp)
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+def _parse_samples(text: str) -> dict:
+    """Prometheus text -> {sample_line_name{labels}: float}."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        key, _, val = line.rpartition(" ")
+        try:
+            out[key] = float(val)
+        except ValueError:
+            pass
+    return out
+
+
+class TestMetricsScrape:
+    def test_curl_metrics_is_valid_and_populated(self, solo_node):
+        # commit a tx so consensus/mempool/WAL series all move
+        res = _rpc(solo_node.rpc_port, "broadcast_tx_commit", tx=b"mk=mv".hex())
+        assert res["deliver_tx"]["code"] == 0
+        solo_node.wait_height(2)
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{solo_node.rpc_port}/metrics", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4"
+            )
+            text = resp.read().decode()
+        samples = _parse_samples(text)
+
+        # consensus height + round-phase latency histograms
+        assert samples["tendermint_consensus_height"] >= 2
+        assert samples['tendermint_consensus_phase_seconds_count{phase="propose"}'] >= 1
+        assert samples['tendermint_consensus_phase_seconds_count{phase="commit"}'] >= 1
+        assert samples["tendermint_consensus_height_seconds_count"] >= 1
+        assert samples["tendermint_consensus_commits_total"] >= 1
+        assert samples["tendermint_consensus_txs_committed_total"] >= 1
+
+        # verify/hash batch histograms (host backend on CPU CI)
+        assert samples['tendermint_verify_batch_size_count{backend="host"}'] >= 1
+        assert samples['tendermint_hash_seconds_count{backend="host"}'] >= 1
+
+        # breaker state series for the resilient verifier
+        assert samples['tendermint_breaker_state{kind="verify"}'] == 0  # closed
+
+        # p2p byte rates + mempool depth are exposed (solo node: zeros)
+        for name in (
+            "tendermint_p2p_sent_bytes_total",
+            "tendermint_p2p_recv_bytes_total",
+            "tendermint_p2p_peers",
+            "tendermint_p2p_send_rate_bytes",
+            "tendermint_mempool_size",
+        ):
+            assert name in samples, name
+
+        # WAL fsync latency moved with the committed inputs
+        assert samples["tendermint_wal_fsync_seconds_count"] >= 1
+        assert samples["tendermint_mempool_txs_total{result=\"ok\"}"] >= 1
+
+    def test_dump_telemetry_rpc(self, solo_node):
+        solo_node.wait_height(1)
+        out = _rpc(solo_node.rpc_port, "dump_telemetry", spans=64)
+        # the three documented sections
+        assert set(out) == {"metrics", "spans", "breakers"}
+        m = out["metrics"]
+        assert m["tendermint_consensus_height"]["type"] == "gauge"
+        assert m["tendermint_consensus_height"]["series"][0]["value"] >= 1
+        # consensus phase spans attributed with height/round
+        names = {s["name"] for s in out["spans"]}
+        assert any(n.startswith("consensus.") for n in names), names
+        span = next(s for s in out["spans"] if s["name"] == "consensus.height")
+        assert span["attrs"]["height"] >= 1
+        assert span["end"] >= span["start"]
+        # breaker snapshot rides along for the resilient verifier
+        assert out["breakers"]["verifier"]["state"] == "closed"
+        assert out["breakers"]["verifier"]["kind"] == "verify"
+
+    def test_dump_telemetry_span_prefix_filter(self, solo_node):
+        solo_node.wait_height(1)
+        out = _rpc(
+            solo_node.rpc_port, "dump_telemetry", spans=32, prefix="consensus."
+        )
+        assert out["spans"], "expected consensus spans after a commit"
+        assert all(s["name"].startswith("consensus.") for s in out["spans"])

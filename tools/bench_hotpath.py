@@ -1,0 +1,231 @@
+"""Hot-path bench driven THROUGH the telemetry registry.
+
+Exercises the verify/hash service backends and the consensus-WAL fsync
+path, then derives `BENCH_hotpath.json` from the same histograms the
+node exports on `GET /metrics` — so bench numbers and production
+telemetry can never disagree about what was measured.
+
+Backend selection is automatic: on CPU (`JAX_PLATFORMS=cpu`, the CI
+shape) only the host backends run — no XLA kernel compiles, finishes in
+seconds. On a TPU backend the device verifier, the valset-table
+verifier, and the device Merkle tree run too (first run pays compiles
+unless the persistent executable cache is warm).
+
+    JAX_PLATFORMS=cpu python tools/bench_hotpath.py          # CI shape
+    python tools/bench_hotpath.py --out BENCH_hotpath.json   # device shape
+
+Output: one JSON line on stdout + the JSON file (default
+`BENCH_hotpath.json` in the CWD).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.utils.jax_cache import enable_persistent_cache
+
+enable_persistent_cache()
+
+
+def _make_sigs(n: int):
+    from tendermint_tpu.crypto.keys import gen_priv_key
+
+    privs = [gen_priv_key(bytes([i % 256]) * 32) for i in range(min(64, n))]
+    msgs = [
+        b'{"chain_id":"hotpath","vote":{"height":7,"round":0,"index":%d}}' % i
+        for i in range(n)
+    ]
+    sigs = [privs[i % len(privs)].sign(m) for i, m in enumerate(msgs)]
+    pubs = [privs[i % len(privs)].pub_key.data for i in range(n)]
+    return pubs, msgs, sigs
+
+
+def drive_verify_host(sizes, reps) -> None:
+    from tendermint_tpu.services.verifier import HostBatchVerifier
+
+    v = HostBatchVerifier()
+    for n in sizes:
+        pubs, msgs, sigs = _make_sigs(n)
+        triples = list(zip(pubs, msgs, sigs))
+        for _ in range(reps):
+            out = v.verify_batch(triples)
+            assert bool(out.all()), "host verify must pass on valid sigs"
+
+
+def drive_verify_device(sizes, reps) -> None:
+    from tendermint_tpu.services.verifier import DeviceBatchVerifier
+
+    v = DeviceBatchVerifier(min_device_batch=1)
+    for n in sizes:
+        pubs, msgs, sigs = _make_sigs(n)
+        triples = list(zip(pubs, msgs, sigs))
+        for _ in range(reps):
+            v.verify_batch(triples)
+
+
+def drive_verify_tables(n_vals: int, stack: int, reps: int) -> None:
+    from tendermint_tpu.services.verifier import TableBatchVerifier
+
+    v = TableBatchVerifier(min_device_batch=1)
+    pubs, msgs, sigs = _make_sigs(n_vals)
+    commits = [(list(msgs), list(sigs))] * stack
+    for _ in range(reps):
+        v.verify_commits(pubs, commits)
+
+
+def drive_hash(sizes, reps, backend: str) -> None:
+    from tendermint_tpu.services.hasher import TreeHasher
+
+    h = TreeHasher(backend=backend, min_device_leaves=2)
+    for n in sizes:
+        items = [b"leaf-%d" % i for i in range(n)]
+        for _ in range(reps):
+            h.root_from_items(items)
+
+
+def drive_wal(n_records: int) -> None:
+    from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
+
+    with tempfile.TemporaryDirectory(prefix="hotpath-wal-") as d:
+        wal = WAL(os.path.join(d, "cs.wal"))
+        for i in range(n_records):
+            wal.save(EndHeightMessage(i))
+        wal.close()
+
+
+def _histo(name: str, **labels):
+    """(count, sum, p50, p99) of an exported histogram series."""
+    from tendermint_tpu.telemetry import REGISTRY
+
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return 0, 0.0, None, None
+    child = fam.labels(**labels) if fam.labelnames else fam._child0()
+    snap = child.value
+    if snap["count"] == 0:
+        return 0, 0.0, None, None
+    return (
+        snap["count"],
+        snap["sum"],
+        child.quantile(0.5),
+        child.quantile(0.99),
+    )
+
+
+def backend_summary(backend: str) -> dict | None:
+    n_calls, t_total, p50, p99 = _histo(
+        "tendermint_verify_seconds", backend=backend
+    )
+    n_sigs, _, _, _ = _histo("tendermint_verify_batch_size", backend=backend)
+    sig_total = _sum_of("tendermint_verify_batch_size", backend=backend)
+    if n_calls == 0 or t_total <= 0:
+        return None
+    return {
+        "calls": n_calls,
+        "signatures": sig_total,
+        "verifies_per_s": round(sig_total / t_total, 1),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+    }
+
+
+def hash_summary(backend: str) -> dict | None:
+    n_calls, t_total, p50, p99 = _histo("tendermint_hash_seconds", backend=backend)
+    leaves = _sum_of("tendermint_hash_batch_leaves", backend=backend)
+    if n_calls == 0 or t_total <= 0:
+        return None
+    return {
+        "calls": n_calls,
+        "leaves": leaves,
+        "leaves_per_s": round(leaves / t_total, 1),
+        "p50_ms": round(p50 * 1e3, 3),
+        "p99_ms": round(p99 * 1e3, 3),
+    }
+
+
+def _sum_of(name: str, **labels) -> float:
+    _, total, _, _ = _histo(name, **labels)
+    return total
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_hotpath.json")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument(
+        "--sizes", default="64,256,1024", help="comma-separated batch sizes"
+    )
+    ap.add_argument(
+        "--wal-records", type=int, default=256, dest="wal_records"
+    )
+    ap.add_argument(
+        "--no-device",
+        action="store_true",
+        help="skip device backends even on TPU",
+    )
+    args = ap.parse_args(argv)
+    sizes = [int(s) for s in args.sizes.split(",") if s]
+
+    import jax
+
+    on_device = jax.default_backend() != "cpu" and not args.no_device
+    t0 = time.time()
+    sys.stderr.write(f"driving host verify {sizes} x{args.reps}...\n")
+    drive_verify_host(sizes, args.reps)
+    sys.stderr.write(f"driving host merkle {sizes} x{args.reps}...\n")
+    drive_hash(sizes, args.reps, "host")
+    sys.stderr.write(f"driving WAL fsync x{args.wal_records}...\n")
+    drive_wal(args.wal_records)
+    if on_device:
+        sys.stderr.write("driving device verify/tables/merkle...\n")
+        drive_verify_device(sizes, args.reps)
+        drive_verify_tables(n_vals=max(sizes), stack=8, reps=args.reps)
+        drive_hash(sizes, args.reps, "device")
+
+    wal_count, wal_sum, wal_p50, wal_p99 = _histo("tendermint_wal_fsync_seconds")
+    detail = {
+        "wall_s": round(time.time() - t0, 2),
+        "backend": jax.default_backend(),
+        "verify": {
+            b: s
+            for b in ("host", "device", "tables")
+            if (s := backend_summary(b)) is not None
+        },
+        "hash": {
+            b: s
+            for b in ("host", "device")
+            if (s := hash_summary(b)) is not None
+        },
+        "wal_fsync": {
+            "count": wal_count,
+            "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
+            "p50_ms": round(wal_p50 * 1e3, 3) if wal_p50 is not None else None,
+            "p99_ms": round(wal_p99 * 1e3, 3) if wal_p99 is not None else None,
+        },
+    }
+    # headline: the fastest verify backend exercised this run
+    best_backend, best = max(
+        detail["verify"].items(), key=lambda kv: kv[1]["verifies_per_s"]
+    )
+    out = {
+        "metric": f"hotpath_{best_backend}_verifies_per_s",
+        "value": best["verifies_per_s"],
+        "unit": "verifies/s",
+        "detail": detail,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
