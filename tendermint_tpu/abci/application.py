@@ -44,3 +44,16 @@ class Application:
     def commit(self) -> Result:
         """Returns the app hash for the next block header."""
         return Result()
+
+    # -- state sync (optional) ----------------------------------------------
+
+    def snapshot_state(self) -> bytes | None:
+        """Serialize the committed app state for a snapshot
+        (`statesync/snapshot.py`). None = this app opts out of serving
+        snapshots; the state-sync reactor then never offers any."""
+        return None
+
+    def restore_state(self, data: bytes) -> None:
+        """Adopt app state from a verified snapshot. Only called after
+        the chunk tree AND the trust anchor checks passed."""
+        raise NotImplementedError(f"{type(self).__name__} cannot restore state")

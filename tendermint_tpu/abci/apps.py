@@ -65,6 +65,24 @@ class KVStoreApp(Application):
             return ResultQuery(log="does not exist", key=data)
         return ResultQuery(key=data, value=v, log="exists")
 
+    # -- state sync ----------------------------------------------------------
+
+    def snapshot_state(self) -> bytes:
+        return json.dumps(
+            {
+                "height": self._height,
+                "data": {k.hex(): v.hex() for k, v in sorted(self._data.items())},
+            },
+            sort_keys=True,
+        ).encode()
+
+    def restore_state(self, data: bytes) -> None:
+        doc = json.loads(data.decode())
+        self._height = doc["height"]
+        self._data = {
+            bytes.fromhex(k): bytes.fromhex(v) for k, v in doc["data"].items()
+        }
+
 
 class PersistentKVStoreApp(KVStoreApp):
     """KVStore persisted to a DB with validator-set changes via special
@@ -112,6 +130,10 @@ class PersistentKVStoreApp(KVStoreApp):
         }
         self._db.set_sync(b"__state__", json.dumps(doc, sort_keys=True).encode())
         return Result(data=self._app_hash())
+
+    def restore_state(self, data: bytes) -> None:
+        super().restore_state(data)
+        self._db.set_sync(b"__state__", data)  # snapshot doc == persist doc
 
 
 class CounterApp(Application):

@@ -89,6 +89,7 @@ class BlockchainReactor(Reactor):
         verifier=None,
         tx_indexer=None,
         hasher=None,
+        deferred: bool = False,
     ) -> None:
         super().__init__()
         self.state = state
@@ -99,6 +100,10 @@ class BlockchainReactor(Reactor):
         self.verifier = verifier
         self.tx_indexer = tx_indexer
         self.hasher = hasher
+        # `deferred` parks the sync thread until state sync restores a
+        # snapshot and calls begin_fast_sync() — the pool's start height
+        # is unknowable before the restore lands
+        self.deferred = deferred
         self.pool = BlockPool(start_height=store.height + 1)
         self._running = False
         self._thread: threading.Thread | None = None
@@ -112,11 +117,33 @@ class BlockchainReactor(Reactor):
 
     def on_start(self) -> None:
         self._running = True
-        if self.fast_sync:
-            self._thread = threading.Thread(
-                target=self._sync_routine, name="fastsync", daemon=True
-            )
-            self._thread.start()
+        if self.fast_sync and not self.deferred:
+            self._start_sync_thread()
+
+    def _start_sync_thread(self) -> None:
+        self._thread = threading.Thread(
+            target=self._sync_routine, name="fastsync", daemon=True
+        )
+        self._thread.start()
+
+    def begin_fast_sync(self, state=None) -> None:
+        """State-sync handoff: adopt the restored state, re-aim the pool
+        at the (snapshot-height advanced) store head, and start syncing
+        the tail. The node calls this from the statesync reactor's
+        `on_synced` (with state=None when state sync gave up and plain
+        fast-sync from the current state proceeds)."""
+        if not self.deferred:
+            return
+        self.deferred = False
+        if state is not None:
+            self.state = state
+        self.pool = BlockPool(start_height=self.store.height + 1)
+        # re-learn peer heights on the fresh pool (status responses that
+        # arrived during state sync went to the old one)
+        if self.switch is not None:
+            self.switch.broadcast(BLOCKCHAIN_CHANNEL, _enc(_MSG_STATUS_REQUEST))
+        if self._running and self.fast_sync:
+            self._start_sync_thread()
 
     def on_stop(self) -> None:
         self._running = False

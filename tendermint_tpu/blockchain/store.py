@@ -44,14 +44,31 @@ class BlockStore:
     def __init__(self, db: DB) -> None:
         self._db = db
         self._height = 0
+        self._base = 0
         raw = db.get(b"blockStore")
         if raw is not None:
-            self._height = json.loads(raw.decode())["height"]
+            doc = json.loads(raw.decode())
+            self._height = doc["height"]
+            # pre-base-tracking watermarks imply a store contiguous from 1
+            self._base = doc.get("base", 1 if doc["height"] > 0 else 0)
 
     @property
     def height(self) -> int:
         """Height of the newest stored block (0 if empty)."""
         return self._height
+
+    @property
+    def base(self) -> int:
+        """Lowest stored height (0 if empty). Snapshot-restored and
+        pruned stores start above 1; `load_block*` below the base
+        answers None, never a decode error."""
+        return self._base
+
+    def _save_watermark(self) -> None:
+        self._db.set_sync(
+            b"blockStore",
+            json.dumps({"height": self._height, "base": self._base}).encode(),
+        )
 
     # -- keys ----------------------------------------------------------------
 
@@ -95,7 +112,67 @@ class BlockStore:
         # commit that made THIS block (what we saw locally)
         self._db.set(self._seen_commit_key(height), seen_commit.encode())
         self._height = height
-        self._db.set_sync(b"blockStore", json.dumps({"height": height}).encode())
+        if self._base == 0:
+            self._base = height
+        self._save_watermark()
+
+    def bootstrap(self, tail: list) -> None:
+        """Seed an EMPTY store from a snapshot's block tail
+        `[(block, seen_commit), ...]` (consecutive, ascending). The
+        store's base becomes the first tail height — earlier heights
+        were never stored here and `load_block*` answers None for them.
+        """
+        if self._height != 0:
+            raise ValidationError(
+                f"bootstrap requires an empty store (height {self._height})"
+            )
+        if not tail:
+            return
+        prev = None
+        for block, seen_commit in tail:
+            height = block.header.height
+            if prev is not None and height != prev + 1:
+                raise ValidationError(
+                    f"snapshot tail is not consecutive: {prev} -> {height}"
+                )
+            prev = height
+            part_set = block.make_part_set()
+            meta = BlockMeta(
+                block_id=BlockID(block.hash(), part_set.header), header=block.header
+            )
+            self._db.set(self._meta_key(height), meta.encode())
+            for i in range(part_set.total):
+                self._db.set(self._part_key(height, i), part_set.get_part(i).encode())
+            self._db.set(self._commit_key(height - 1), block.last_commit.encode())
+            self._db.set(self._seen_commit_key(height), seen_commit.encode())
+        self._base = tail[0][0].header.height
+        self._height = tail[-1][0].header.height
+        self._save_watermark()
+
+    def prune(self, retain_height: int) -> int:
+        """Delete blocks below `retain_height` (exclusive), bounding the
+        disk a snapshot-serving node spends on history (reference
+        `PruneBlocks store.go`). Returns the number of heights pruned;
+        the head block is always retained."""
+        if retain_height > self._height:
+            retain_height = self._height
+        if self._base == 0 or retain_height <= self._base:
+            return 0
+        pruned = 0
+        for h in range(self._base, retain_height):
+            meta = self.load_block_meta(h)
+            if meta is not None:
+                for i in range(meta.block_id.parts_header.total):
+                    self._db.delete(self._part_key(h, i))
+            self._db.delete(self._meta_key(h))
+            self._db.delete(self._seen_commit_key(h))
+            # C:h-1 rides with block h, so this keeps the canonical
+            # commit for retain_height-1 (written by block retain_height)
+            self._db.delete(self._commit_key(h - 1))
+            pruned += 1
+        self._base = retain_height
+        self._save_watermark()
+        return pruned
 
     # -- load ----------------------------------------------------------------
 

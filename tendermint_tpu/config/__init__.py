@@ -67,6 +67,31 @@ class P2PConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """Snapshot bootstrap + serving (`tendermint_tpu/statesync/`).
+
+    `enable` turns on the bootstrap mode: a fresh node discovers peer
+    snapshots, restores the newest one that passes certifier anchoring,
+    and fast-syncs only the tail. `trust_height`/`trust_hash` pin the
+    operator's known-good header (light-client subjective init) —
+    REQUIRED in production; empty falls back to trusting the genesis
+    validator set. `snapshot_interval` > 0 makes this node SERVE
+    snapshots, taken every that many committed heights."""
+
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""  # hex header hash at trust_height
+    trust_period_s: float = 0.0  # 0 = no anchoring-header freshness check
+    snapshot_interval: int = 0  # 0 = don't take/serve snapshots
+    snapshot_keep_recent: int = 2
+    chunk_size: int = 65536
+    discovery_time_s: float = 3.0
+    chunk_request_timeout_s: float = 10.0
+    chunk_inflight_per_peer: int = 4
+    giveup_time_s: float = 45.0  # then fall back to plain fast-sync
+
+
+@dataclass
 class MempoolConfig:
     """Reference `config/config.go:267-288`."""
 
@@ -84,6 +109,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
 
     # -- derived paths -----------------------------------------------------
 
@@ -116,6 +142,9 @@ class Config:
         cfg.rpc.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.laddr = "tcp://127.0.0.1:0"
         cfg.p2p.pex_ensure_interval_s = 0.5
+        cfg.statesync.discovery_time_s = 0.5
+        cfg.statesync.chunk_request_timeout_s = 3.0
+        cfg.statesync.giveup_time_s = 20.0
         try:
             import cryptography  # noqa: F401
         except ImportError:
@@ -129,7 +158,7 @@ class Config:
         return cfg
 
 
-_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus")
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "statesync")
 
 
 def write_config(cfg: Config) -> str:

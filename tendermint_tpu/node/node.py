@@ -139,6 +139,11 @@ class Node:
             == self.priv_validator.address
         )
         fast_sync = cfg.base.fast_sync and not solo
+        # state-sync bootstrap: only a FRESH node (nothing committed
+        # locally) may skip history, and it needs fast-sync for the tail
+        state_sync = (
+            cfg.statesync.enable and fast_sync and self.state.last_block_height == 0
+        )
 
         # Device tree hasher for proposal data_hash/part sets on TPU
         # (reference SimpleHash hot spots `types/tx.go:33-46`,
@@ -177,10 +182,54 @@ class Node:
             verifier=verifier,
             tx_indexer=self.tx_indexer,
             hasher=hasher,
+            deferred=state_sync,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast
         )
+
+        # state sync: every node serves its snapshot store on the 0x60
+        # channel; `state_sync` additionally runs the bootstrap routine
+        # (discover -> anchor -> chunks -> restore -> fast-sync tail)
+        from tendermint_tpu.statesync.reactor import StateSyncReactor
+        from tendermint_tpu.statesync.snapshot import SnapshotStore
+        from tendermint_tpu.statesync.trust import TrustAnchor, TrustOptions
+
+        self.snapshot_store = SnapshotStore(
+            _db("snapshots"),
+            hasher=hasher,
+            chunk_size=cfg.statesync.chunk_size,
+            keep_recent=cfg.statesync.snapshot_keep_recent,
+        )
+        trust_anchor = TrustAnchor(
+            chain_id=self.genesis.chain_id,
+            base_validators=self.genesis.validator_set(),
+            options=TrustOptions.from_config(cfg.statesync),
+            verifier=verifier,
+        )
+        self.statesync_reactor = StateSyncReactor(
+            snapshot_store=self.snapshot_store,
+            block_store=self.block_store,
+            state=self.state,
+            sync=state_sync,
+            trust_anchor=trust_anchor,
+            state_db=self.state_db,
+            app_restore_fn=getattr(self.app, "restore_state", None),
+            app_snapshot_fn=getattr(self.app, "snapshot_state", None),
+            on_synced=self._on_state_synced,
+            hasher=hasher,
+            snapshot_interval=cfg.statesync.snapshot_interval,
+            discovery_time_s=cfg.statesync.discovery_time_s,
+            chunk_request_timeout_s=cfg.statesync.chunk_request_timeout_s,
+            chunk_inflight_per_peer=cfg.statesync.chunk_inflight_per_peer,
+            giveup_time_s=cfg.statesync.giveup_time_s,
+        )
+        if cfg.statesync.snapshot_interval > 0:
+            # runs on the consensus thread right after each commit, so
+            # consensus state and app state snapshot at the same height
+            self.event_switch.add_listener(
+                "statesync", ev.EVENT_NEW_BLOCK, lambda _data: self._maybe_snapshot()
+            )
 
         self.switch = Switch(
             NodeInfo(
@@ -216,6 +265,7 @@ class Node:
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("statesync", self.statesync_reactor)
         self.pex_reactor = None
         if cfg.p2p.pex:
             from tendermint_tpu.p2p.addrbook import AddrBook
@@ -255,6 +305,25 @@ class Node:
         """Fast-sync finished: start consensus (reference
         `SwitchToConsensus`)."""
         self.consensus_reactor.switch_to_consensus(state)
+
+    def _on_state_synced(self, state) -> None:
+        """State sync ended: with a restored state, adopt it and
+        fast-sync only the tail; with None (gave up), fall back to plain
+        fast-sync from the current (genesis) state."""
+        if state is not None:
+            self.state = state
+            self.statesync_reactor.state = state
+        self.blockchain_reactor.begin_fast_sync(state)
+
+    def _maybe_snapshot(self) -> None:
+        try:
+            self.statesync_reactor.maybe_take_snapshot(
+                self.current_state, app=self.app
+            )
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception("snapshot failed")
 
     @property
     def _node_key(self):

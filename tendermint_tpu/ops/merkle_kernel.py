@@ -259,3 +259,31 @@ def merkle_root_device(items: list[bytes], algo: str = "sha256") -> bytes:
     if not items:
         return b""
     return merkle_roots_forest([items], algo)[0]
+
+
+def leaf_hashes_device(items: list[bytes], algo: str = "sha256") -> list[bytes]:
+    """Domain-separated leaf hashes for every item in ONE batched device
+    launch (bit-equal to `merkle.simple.leaf_hash` per item). The
+    state-sync chunk verifier uses this to check received chunk windows
+    against a manifest's hash list without a per-chunk host hash loop.
+    """
+    from tendermint_tpu.ops.padding import (
+        digests_to_bytes_be,
+        digests_to_bytes_le,
+        pad_ripemd160_prefixed,
+        pad_sha256_prefixed,
+    )
+
+    if not items:
+        return []
+    if algo == "ripemd160":
+        from tendermint_tpu.ops.ripemd160_kernel import _ripemd160_masked
+
+        blocks, n_blocks = pad_ripemd160_prefixed(items, LEAF_PREFIX)
+        digs = _ripemd160_masked(blocks, n_blocks, blocks.shape[1])
+        return digests_to_bytes_le(np.asarray(digs))
+    from tendermint_tpu.ops.sha256_kernel import _sha256_masked
+
+    blocks, n_blocks = pad_sha256_prefixed(items, LEAF_PREFIX)
+    digs = _sha256_masked(blocks, n_blocks, blocks.shape[1])
+    return digests_to_bytes_be(np.asarray(digs))

@@ -148,6 +148,11 @@ class MConnection:
         self._send_wake.set()
         return True
 
+    def send_queue_depth(self) -> int:
+        """Frames queued across all channels — the backpressure signal
+        the `tendermint_p2p_send_queue_*` gauges roll up."""
+        return sum(ch.queue.qsize() for ch in self._channels.values())
+
     def _pick_channel(self) -> _Channel | None:
         """Least-recently-sent-relative-to-priority scheduling (the
         reference's sendSomePacketMsgs weighting)."""

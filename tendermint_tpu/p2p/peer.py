@@ -109,6 +109,9 @@ class Peer:
     def recv_monitor(self):
         return self._conn.recv_monitor
 
+    def send_queue_depth(self) -> int:
+        return self._conn.send_queue_depth()
+
     @property
     def remote_addr(self) -> str:
         """Socket-level remote address ("" for in-memory transports)."""

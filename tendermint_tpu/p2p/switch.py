@@ -142,6 +142,22 @@ class Switch:
     def recv_rate_total(self) -> float:
         return sum(p.recv_monitor.rate for p in self.peers())
 
+    def send_queue_depth_total(self) -> int:
+        """Frames queued for send across all peers — rollup for the
+        `tendermint_p2p_send_queue_depth` gauge (per-peer label series
+        would be unbounded cardinality; see telemetry/metrics.py)."""
+        return sum(p.send_queue_depth() for p in self.peers())
+
+    def send_queue_depth_max(self) -> int:
+        """Deepest single-peer send queue (one slow peer vs global
+        backpressure)."""
+        return max((p.send_queue_depth() for p in self.peers()), default=0)
+
+    def send_queue_depths(self) -> dict[str, int]:
+        """Per-peer depths for diagnostics (dump_telemetry RPC), keyed
+        by peer id — deliberately NOT exported as labeled series."""
+        return {p.id: p.send_queue_depth() for p in self.peers()}
+
     def add_peer_endpoint(
         self, remote_info: NodeInfo, endpoint: Endpoint, outbound: bool
     ) -> Peer:

@@ -252,6 +252,9 @@ def make_routes(node) -> dict:
             "metrics": REGISTRY.to_dict(),
             "spans": TRACER.recent(n=int(spans), prefix=str(prefix)),
             "breakers": breakers,
+            # per-peer view the exported gauges deliberately aggregate
+            # (peer-id label cardinality — docs/OBSERVABILITY.md)
+            "p2p": {"send_queues": node.switch.send_queue_depths()},
         }
 
     def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:

@@ -103,6 +103,8 @@ def _make_handler(routes: dict, event_switch=None):
             self.wfile.write(body)
 
         def _call(self, req_id, method, params):
+            import time as time_mod
+
             from tendermint_tpu.telemetry import metrics as _metrics
 
             fn = routes.get(method)
@@ -115,11 +117,18 @@ def _make_handler(routes: dict, event_switch=None):
                     "id": req_id,
                     "error": {"code": -32601, "message": f"unknown method {method}"},
                 }
+            t0 = time_mod.perf_counter()
             try:
                 result = fn(**params) if isinstance(params, dict) else fn(*params)
+                _metrics.RPC_SECONDS.labels(method=method).observe(
+                    time_mod.perf_counter() - t0
+                )
                 _metrics.RPC_REQUESTS.labels(method=method, result="ok").inc()
                 return {"jsonrpc": "2.0", "id": req_id, "result": result}
             except RPCError as e:
+                _metrics.RPC_SECONDS.labels(method=method).observe(
+                    time_mod.perf_counter() - t0
+                )
                 _metrics.RPC_REQUESTS.labels(method=method, result="error").inc()
                 return {
                     "jsonrpc": "2.0",

@@ -100,6 +100,21 @@ class TreeHasher:
         _observe_hash("host", len(hashes), time.perf_counter() - t0)
         return out
 
+    def leaf_hashes(self, items: list[bytes]) -> list[bytes]:
+        """Per-item domain-separated leaf hashes (state-sync chunk
+        verification) — one batched device launch above the threshold,
+        host hashlib below it."""
+        t0 = time.perf_counter()
+        if self._use_device(len(items)):
+            from tendermint_tpu.ops.merkle_kernel import leaf_hashes_device
+
+            out = leaf_hashes_device(items, self.algo)
+            _observe_hash("device", len(items), time.perf_counter() - t0)
+            return out
+        out = [host_merkle.leaf_hash(x, self.algo) for x in items]
+        _observe_hash("host", len(items), time.perf_counter() - t0)
+        return out
+
     def proofs(self, items: list[bytes]):
         """Merkle proofs stay on host: O(N log N) pointer work, tiny data."""
         return host_merkle.simple_proofs_from_byte_slices(items, self.algo)

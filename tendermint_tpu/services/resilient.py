@@ -333,5 +333,10 @@ class ResilientTreeHasher(TreeHasher):
             self.primary.root_from_hashes, self.fallback.root_from_hashes, hashes
         )
 
+    def leaf_hashes(self, items: list[bytes]) -> list[bytes]:
+        return self._dispatch.call(
+            self.primary.leaf_hashes, self.fallback.leaf_hashes, items
+        )
+
     def proofs(self, items: list[bytes]):
         return self.fallback.proofs(items)

@@ -197,6 +197,24 @@ class State:
             doc = {"last_changed": changed}
         self.db.set(self._validators_key(next_height), json.dumps(doc, sort_keys=True).encode())
 
+    def save_validators_full(self) -> None:
+        """Write the FULL current validator set at its change height.
+
+        Snapshot restore seeds a fresh state DB with this so the
+        change-height pointers `save_validators_info` writes afterwards
+        resolve (`load_validators` would otherwise chase a pointer into
+        pre-snapshot history this node never stored)."""
+        if self.db is None:
+            return
+        doc = {
+            "last_changed": self.last_height_validators_changed,
+            "validators": _valset_to_dict(self.validators),
+        }
+        self.db.set(
+            self._validators_key(self.last_height_validators_changed),
+            json.dumps(doc, sort_keys=True).encode(),
+        )
+
     def load_validators(self, height: int) -> ValidatorSet:
         """Validator set that was responsible for signing at `height`."""
         if self.db is None:

@@ -133,6 +133,48 @@ for _kind in ("verify", "hash", "tables"):
 for _phase in ("prevote", "precommit"):
     CONSENSUS_ROUND_SKIPS.labels(phase=_phase).inc(0)
 
+# -- state sync ---------------------------------------------------------------
+
+STATESYNC_CHUNKS = Counter(
+    "tendermint_statesync_chunks_total",
+    "Snapshot chunks received while syncing (ok/corrupt/timeout)",
+    labelnames=("result",),
+)
+STATESYNC_CHUNKS_SERVED = Counter(
+    "tendermint_statesync_chunks_served_total",
+    "Snapshot chunks served to syncing peers",
+)
+STATESYNC_CHUNK_VERIFY_SECONDS = Histogram(
+    "tendermint_statesync_chunk_verify_seconds",
+    "Batched Merkle verification latency over a full snapshot chunk set",
+    buckets=LATENCY_BUCKETS,
+)
+STATESYNC_RESTORE_SECONDS = Histogram(
+    "tendermint_statesync_restore_seconds",
+    "Wall time from snapshot selection to restored state (incl. chunk fetch)",
+    buckets=LATENCY_BUCKETS,
+)
+STATESYNC_SNAPSHOT_SECONDS = Histogram(
+    "tendermint_statesync_snapshot_seconds",
+    "Snapshot creation latency (serialize + chunk + device tree + persist)",
+    buckets=LATENCY_BUCKETS,
+)
+STATESYNC_SNAPSHOTS_TAKEN = Counter(
+    "tendermint_statesync_snapshots_taken_total", "Snapshots created by this node"
+)
+STATESYNC_SNAPSHOTS_REJECTED = Counter(
+    "tendermint_statesync_snapshots_rejected_total",
+    "Offered snapshots rejected (trust anchoring, bad chunks, timeouts)",
+)
+STATESYNC_RESTORES = Counter(
+    "tendermint_statesync_restores_total",
+    "Snapshot restore attempts by outcome (ok/failed)",
+    labelnames=("result",),
+)
+
+for _result in ("ok", "corrupt", "timeout"):
+    STATESYNC_CHUNKS.labels(result=_result).inc(0)
+
 # -- p2p ----------------------------------------------------------------------
 
 P2P_SENT_BYTES = Counter(
@@ -147,6 +189,19 @@ P2P_SEND_RATE = Gauge(
 )
 P2P_RECV_RATE = Gauge(
     "tendermint_p2p_recv_rate_bytes", "Aggregate recv rate over live peers, bytes/s"
+)
+# Send-queue depth is the backpressure signal: a climbing depth means a
+# peer drains slower than reactors produce. Exported as the aggregate
+# sum and the worst single peer (per-peer series would be unbounded
+# cardinality — peer ids churn; the max pinpoints "one slow peer"
+# vs "everyone backed up" without it).
+P2P_SEND_QUEUE = Gauge(
+    "tendermint_p2p_send_queue_depth",
+    "Frames queued for send across all peers and channels",
+)
+P2P_SEND_QUEUE_MAX = Gauge(
+    "tendermint_p2p_send_queue_max",
+    "Deepest single-peer send queue (frames)",
 )
 
 # -- mempool ------------------------------------------------------------------
@@ -176,6 +231,12 @@ RPC_REQUESTS = Counter(
     "RPC calls served, by method and outcome",
     labelnames=("method", "result"),
 )
+RPC_SECONDS = Histogram(
+    "tendermint_rpc_request_seconds",
+    "RPC handler latency by method (dispatch to result, excl. socket I/O)",
+    labelnames=("method",),
+    buckets=LATENCY_BUCKETS,
+)
 
 
 def bind_node_gauges(node) -> None:
@@ -186,4 +247,6 @@ def bind_node_gauges(node) -> None:
     P2P_PEERS.set_function(lambda: node.switch.n_peers() if node.switch else 0)
     P2P_SEND_RATE.set_function(lambda: node.switch.send_rate_total())
     P2P_RECV_RATE.set_function(lambda: node.switch.recv_rate_total())
+    P2P_SEND_QUEUE.set_function(lambda: node.switch.send_queue_depth_total())
+    P2P_SEND_QUEUE_MAX.set_function(lambda: node.switch.send_queue_depth_max())
     MEMPOOL_SIZE.set_function(lambda: node.mempool.size())

@@ -211,12 +211,130 @@ class NemesisNode:
         return self.cs.height
 
 
+class FullNemesisNode:
+    """One rebuildable in-process FULL node (`node.Node`): fast-sync +
+    mempool + RPC + state-sync reactors under chaos, not just the
+    ConsensusState core `NemesisNode` drives.
+
+    Durable pieces survive restart exactly like a real deployment: the
+    MemDB-backed state/blockstore/txindex/snapshot DBs, the app
+    instance, and the on-disk WALs under `home/fullnode<i>/`. The
+    runtime (Node with its switch, reactors, RPC listener) is rebuilt.
+    In-process wiring: `p2p.laddr` is empty (no TCP listener) and the
+    harness links switches over chaos-wrapped pipes.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        genesis,
+        privs,
+        home: str,
+        chain_id: str,
+        config=None,
+        verifier=None,
+        hasher=None,
+        app_factory=None,
+        config_mutator=None,
+    ) -> None:
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.config import Config
+        from tendermint_tpu.db.kv import MemDB
+
+        self.index = index
+        self.chain_id = chain_id
+        self.genesis = genesis
+        self.priv_validator = privs[index] if index < len(privs) else None
+        self.home = os.path.join(home, f"fullnode{index}")
+        os.makedirs(self.home, exist_ok=True)
+        self.app = (app_factory or KVStoreApp)()
+        self.verifier = verifier
+        self.hasher = hasher
+        self._dbs: dict[str, object] = {}
+        self._memdb = MemDB
+        if config is None:
+            config = Config.test_config(self.home)
+            config.base.moniker = f"fullnemesis{index}"
+            config.p2p.laddr = ""  # harness-wired pipes, no TCP accept
+            config.p2p.pex = False
+            config.rpc.grpc_laddr = ""
+            config.consensus = NemesisNode.default_config()
+        if config_mutator is not None:
+            config_mutator(config)
+        self.config = config
+        self.running = False
+        self._build()
+
+    def _db_provider(self, name: str):
+        db = self._dbs.get(name)
+        if db is None:
+            db = self._dbs[name] = self._memdb()
+        return db
+
+    def _build(self) -> None:
+        from tendermint_tpu.node.node import Node
+
+        self.node = Node(
+            self.config,
+            genesis=self.genesis,
+            priv_validator=self.priv_validator,
+            app=self.app,
+            db_provider=self._db_provider,
+            verifier=self.verifier,
+            hasher=self.hasher,
+        )
+
+    # -- the informal node interface the harness drives --------------------
+
+    @property
+    def switch(self):
+        return self.node.switch
+
+    @property
+    def store(self):
+        return self.node.block_store
+
+    @property
+    def cs(self):
+        return self.node.consensus
+
+    @property
+    def height(self) -> int:
+        return self.node.block_store.height
+
+    @property
+    def rpc_port(self) -> int:
+        return self.node.rpc_port
+
+    def start(self) -> None:
+        self.node.start()
+        self.running = True
+
+    def stop(self) -> None:
+        if self.running:
+            self.node.stop()
+            self.running = False
+
+    def crash(self) -> None:
+        """Abrupt teardown; WALs keep whatever the last fsync wrote."""
+        self.stop()
+
+    def restart(self) -> None:
+        if self.running:
+            raise RuntimeError(f"fullnode{self.index} is running; crash() first")
+        self._build()
+        self.start()
+
+
 class Nemesis:
     """N-node in-process network + fault primitives + live invariants.
 
     Use as a context manager: `with Nemesis(4, home=tmp) as net: ...` —
     exit stops everything and re-raises any invariant violation the
-    background monitor recorded.
+    background monitor recorded. `node_factory` swaps the node type:
+    the default drives consensus cores (`NemesisNode`), pass
+    `Nemesis.full_node_factory()` to drive complete `node.Node`
+    instances (fast-sync + mempool + RPC + state-sync under chaos).
     """
 
     def __init__(
@@ -230,6 +348,7 @@ class Nemesis:
         verifier_factory=None,
         hasher_factory=None,
         monitor_interval_s: float = 0.25,
+        node_factory=None,
     ) -> None:
         import tempfile
 
@@ -238,8 +357,9 @@ class Nemesis:
         self.fuzz = fuzz
         genesis, privs = make_genesis(n_vals or n_nodes, chain_id=chain_id)
         self.genesis, self.privs = genesis, privs
+        self.node_factory = node_factory or NemesisNode
         self.nodes = [
-            NemesisNode(
+            self.node_factory(
                 i,
                 genesis,
                 privs,
@@ -258,6 +378,28 @@ class Nemesis:
         self._monitor: threading.Thread | None = None
         self._monitor_stop = threading.Event()
         self.violations: list[str] = []
+
+    @staticmethod
+    def full_node_factory(app_factory=None, config_mutator=None):
+        """A `node_factory` building `FullNemesisNode`s; `config_mutator`
+        edits each node's Config before composition (snapshot intervals,
+        state-sync trust roots, ...)."""
+
+        def factory(i, genesis, privs, home, chain_id, config=None, verifier=None, hasher=None):
+            return FullNemesisNode(
+                i,
+                genesis,
+                privs,
+                home,
+                chain_id,
+                config=config,
+                verifier=verifier,
+                hasher=hasher,
+                app_factory=app_factory,
+                config_mutator=config_mutator,
+            )
+
+        return factory
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -366,6 +508,20 @@ class Nemesis:
             key = (min(i, j), max(i, j))
             self._links.pop(key, None)  # old endpoints died with the crash
             self._connect(*key)
+
+    def add_node(self, node) -> int:
+        """Admit a late joiner (e.g. a fresh node that will state-sync
+        in): start it and link it to every running node. Links inherit
+        the live partition — declare the joiner's group in `partition`
+        BEFORE adding it, or it starts fully isolated."""
+        i = len(self.nodes)
+        self.nodes.append(node)
+        if not node.running:
+            node.start()
+        for j, other in enumerate(self.nodes[:i]):
+            if other.running:
+                self._connect(j, i)
+        return i
 
     def crash_at_fail_point(self, index: int) -> None:
         """Arm the process-wide fail-point counter (`utils/fail.py`) in

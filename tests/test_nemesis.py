@@ -257,3 +257,107 @@ class TestChaosSoak:
             net.crash(victim)
             net.restart(victim)
             net.wait_height(max(net.heights()) + 1, timeout=120)
+
+
+class TestFullNodeChaos:
+    """The harness driving COMPLETE `node.Node` instances (fast-sync +
+    mempool + RPC + state-sync reactors) instead of bare consensus
+    cores — the open ROADMAP resilience item."""
+
+    @staticmethod
+    def _rpc(port, method, **params):
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps(
+                {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.load(resp)
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out["result"]
+
+    def test_statesync_joiner_mid_partition_converges(self, tmp_path):
+        """THE state-sync chaos scenario: a 4-validator full-node
+        network serves snapshots; one validator is partitioned away; a
+        FRESH node joins mid-partition, state-syncs from the majority
+        (store base > 1 proves no genesis replay), commits a tx fed
+        through the RPC layer under the partition, then the partition
+        heals and everyone — including the stale validator — converges.
+        Invariants (no-fork, commit agreement) run continuously."""
+
+        def serving(cfg):
+            cfg.statesync.snapshot_interval = 3
+
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(config_mutator=serving),
+        ) as net:
+            net.wait_height(5, timeout=90)
+            assert net.nodes[0].node.snapshot_store.list_manifests()
+            # isolate validator 3; 3/4 voting power keeps committing.
+            # group 4 now so the joiner's links inherit correctly.
+            net.partition({0, 1, 2, 4}, {3})
+            stale_height = net.nodes[3].store.height
+            # tx through the RPC layer while partitioned
+            res = self._rpc(
+                net.nodes[0].rpc_port, "broadcast_tx_sync", tx=b"chaos-k=chaos-v".hex()
+            )
+            assert res["code"] == 0
+
+            from tendermint_tpu.testing.nemesis import FullNemesisNode
+
+            def joining(cfg):
+                cfg.statesync.enable = True
+
+            joiner = FullNemesisNode(
+                4,
+                net.genesis,
+                net.privs,
+                net.home,
+                net.chain_id,
+                config_mutator=joining,
+            )
+            net.add_node(joiner)
+            deadline = time.monotonic() + 45
+            while time.monotonic() < deadline:
+                if joiner.node.statesync_reactor.restored_state is not None:
+                    break
+                time.sleep(0.1)
+            assert joiner.node.statesync_reactor.restored_state is not None
+            assert joiner.store.base > 1  # snapshot-restored, not replayed
+            # the joiner converges with the majority despite the partition
+            net.wait_height(
+                net.nodes[0].store.height + 2, nodes=[0, 1, 2, 4], timeout=60
+            )
+            # RPC on the JOINER serves the restored/synced chain
+            status = self._rpc(joiner.rpc_port, "status")
+            assert int(status["sync_info"]["latest_block_height"]) > 1
+            assert joiner.app._data.get(b"chaos-k") == b"chaos-v"
+
+            net.heal()
+            # the stale validator fast-syncs back past its partition-era
+            # height and the whole net (5 nodes) keeps agreeing
+            net.wait_height(stale_height + 3, timeout=90)
+
+    def test_full_node_crash_restart_under_chaos(self, tmp_path):
+        """Crash/restart of a full node (WAL + handshake recovery) with
+        per-link delay chaos active — the NemesisNode crash matrix
+        promoted to whole-node scope."""
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(),
+        ) as net:
+            net.wait_height(3, timeout=90)
+            net.delay(0, 1, 0.05)
+            net.crash(3)
+            net.wait_progress(delta=2, nodes=[0, 1, 2], timeout=60)
+            net.restart(3)
+            net.wait_height(max(net.heights()) + 2, timeout=90)

@@ -90,6 +90,54 @@ def drive_hash(sizes, reps, backend: str) -> None:
             h.root_from_items(items)
 
 
+def drive_statesync(payload_kb: int, chunk_size: int, reps: int) -> None:
+    """Snapshot take + full chunk-set verification through the service
+    seam — fills tendermint_statesync_snapshot_seconds /
+    _chunk_verify_seconds exactly as a serving/restoring node would."""
+    from tendermint_tpu.db.kv import MemDB
+    from tendermint_tpu.services.hasher import TreeHasher
+    from tendermint_tpu.state.state import make_genesis_state
+    from tendermint_tpu.statesync.snapshot import SnapshotStore, verify_chunks
+    from tendermint_tpu.testing.nemesis import make_genesis
+
+    genesis, _ = make_genesis(4, chain_id="bench-statesync")
+    hasher = TreeHasher(backend="host")
+    app_state = os.urandom(payload_kb * 1024)
+    for _ in range(reps):
+        st = make_genesis_state(MemDB(), genesis)
+        st.last_block_height = 5
+        st.app_hash = b"\xab" * 20
+        store = SnapshotStore(MemDB(), hasher=hasher, chunk_size=chunk_size)
+        m = store.take(st, app_state)
+        chunks = [store.load_chunk(m.height, m.format, i) for i in range(m.chunks)]
+        verify_chunks(m, chunks, hasher)
+
+
+def statesync_summary() -> dict | None:
+    n_snap, t_snap, snap_p50, snap_p99 = _histo(
+        "tendermint_statesync_snapshot_seconds"
+    )
+    n_ver, t_ver, ver_p50, ver_p99 = _histo(
+        "tendermint_statesync_chunk_verify_seconds"
+    )
+    if n_snap == 0 and n_ver == 0:
+        return None
+    out = {}
+    if n_snap:
+        out["snapshot"] = {
+            "count": n_snap,
+            "p50_ms": round(snap_p50 * 1e3, 3),
+            "p99_ms": round(snap_p99 * 1e3, 3),
+        }
+    if n_ver:
+        out["chunk_verify"] = {
+            "count": n_ver,
+            "p50_ms": round(ver_p50 * 1e3, 3),
+            "p99_ms": round(ver_p99 * 1e3, 3),
+        }
+    return out
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -166,6 +214,13 @@ def main(argv=None) -> int:
         "--wal-records", type=int, default=256, dest="wal_records"
     )
     ap.add_argument(
+        "--statesync-kb",
+        type=int,
+        default=256,
+        dest="statesync_kb",
+        help="snapshot payload size driven through take+verify (0 skips)",
+    )
+    ap.add_argument(
         "--no-device",
         action="store_true",
         help="skip device backends even on TPU",
@@ -183,6 +238,11 @@ def main(argv=None) -> int:
     drive_hash(sizes, args.reps, "host")
     sys.stderr.write(f"driving WAL fsync x{args.wal_records}...\n")
     drive_wal(args.wal_records)
+    if args.statesync_kb > 0:
+        sys.stderr.write(
+            f"driving statesync snapshot+verify {args.statesync_kb}KB x{args.reps}...\n"
+        )
+        drive_statesync(args.statesync_kb, chunk_size=16 * 1024, reps=args.reps)
     if on_device:
         sys.stderr.write("driving device verify/tables/merkle...\n")
         drive_verify_device(sizes, args.reps)
@@ -203,6 +263,7 @@ def main(argv=None) -> int:
             for b in ("host", "device")
             if (s := hash_summary(b)) is not None
         },
+        "statesync": statesync_summary(),
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
