@@ -183,11 +183,15 @@ class BlockPool:
                 rec.monitor.update(size)
         return True
 
-    def peek(self, n: int) -> list:
-        """Up to n CONSECUTIVE blocks starting at self.height."""
+    def peek(self, n: int, from_height: int | None = None) -> list:
+        """Up to n CONSECUTIVE blocks starting at `from_height`
+        (default self.height). The offset form lets the fast-sync
+        pipeline prep window K+1 while window K's blocks — still below
+        self.height+... — wait un-popped for their in-flight verdict."""
+        start = self.height if from_height is None else from_height
         with self._lock:
             out = []
-            for h in range(self.height, self.height + n):
+            for h in range(start, start + n):
                 if h not in self._blocks:
                     break
                 out.append(self._blocks[h][0])

@@ -17,6 +17,7 @@ it applies.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -43,6 +44,13 @@ _MSG_STATUS_RESPONSE = 0x05
 _SYNC_TICK_S = 0.01
 _STATUS_INTERVAL_S = 2.0  # reference statusUpdateIntervalSeconds=10, scaled
 VERIFY_WINDOW = 16  # commits batched per device call
+
+# Verify windows kept in flight on device at once (docs/PERFORMANCE.md).
+# 2 is the classic software pipeline: window K's verdict flies while the
+# host preps K+1's part sets/lanes and applies K-1's blocks via ABCI.
+# 1 degenerates to the synchronous verify->apply loop (the bench
+# baseline); >2 only helps when apply is slower than a device launch.
+PIPELINE_DEPTH = int(os.environ.get("TENDERMINT_TPU_PIPELINE_DEPTH", "2"))
 
 
 def _enc(tag: int, *fields) -> bytes:
@@ -90,6 +98,7 @@ class BlockchainReactor(Reactor):
         tx_indexer=None,
         hasher=None,
         deferred: bool = False,
+        pipeline_depth: int | None = None,
     ) -> None:
         super().__init__()
         self.state = state
@@ -105,6 +114,10 @@ class BlockchainReactor(Reactor):
         # is unknowable before the restore lands
         self.deferred = deferred
         self.pool = BlockPool(start_height=store.height + 1)
+        self.pipeline_depth = max(
+            1, PIPELINE_DEPTH if pipeline_depth is None else pipeline_depth
+        )
+        self._dispatch_queue = None  # lazy: only fast-syncing nodes need it
         self._running = False
         self._thread: threading.Thread | None = None
         self.blocks_synced = 0
@@ -147,6 +160,8 @@ class BlockchainReactor(Reactor):
 
     def on_stop(self) -> None:
         self._running = False
+        if self._dispatch_queue is not None:
+            self._dispatch_queue.close()
 
     def add_peer(self, peer: Peer) -> None:
         # advertise our height + learn theirs (reference `AddPeer`)
@@ -218,73 +233,186 @@ class BlockchainReactor(Reactor):
                 return
             time.sleep(_SYNC_TICK_S)
 
+    def _queue(self):
+        """The reactor-owned dispatch queue (one per fast-syncing node,
+        so another consumer's unjoined handles can't backpressure us)."""
+        if self._dispatch_queue is None:
+            from tendermint_tpu.services.dispatch import DispatchQueue
+
+            self._dispatch_queue = DispatchQueue(
+                depth=self.pipeline_depth, name="fastsync"
+            )
+        return self._dispatch_queue
+
     def _try_sync(self) -> None:
         """Verify + apply as many downloaded blocks as possible, commits
-        batched per device call (reference `trySync` loop `:242-289`)."""
-        while True:
-            window = self.pool.peek(VERIFY_WINDOW + 1)
-            if len(window) < 2:
-                return
-            # the batch spans consecutive blocks under ONE valset
-            val_hash = self.state.validators.hash()
-            usable = 0
-            for b in window:
-                if b.header.validators_hash != val_hash:
-                    break
-                usable += 1
-            if usable < 2:
-                # valset changed at the very next block: verify it alone
-                # via its successor's commit the slow way
-                self._sync_one(window[0], window[1] if len(window) > 1 else None)
-                continue
+        batched per device call (reference `trySync` loop `:242-289`) —
+        run as a SOFTWARE PIPELINE over the async dispatch layer: while
+        window K's commit verdict is in flight on device, the host preps
+        window K+1's part sets/lanes and applies window K-1's blocks
+        through ABCI. Windows join strictly in submission order; any
+        redo / valset change / verdict failure drains the in-flight
+        suffix WITHOUT applying its blocks (they chain off the fault).
+        """
+        from collections import deque
 
-            blocks = window[:usable]
-            # commit for blocks[i] rides in blocks[i+1].last_commit; the
-            # final block waits for its successor in a later window, so
-            # only the applied prefix needs part sets / ids built
-            apply_n = usable - 1
-            parts = [b.make_part_set() for b in blocks[:apply_n]]
-            block_ids = [
-                BlockID(b.hash(), ps.header)
-                for b, ps in zip(blocks[:apply_n], parts)
-            ]
-            entries = []
-            for i in range(apply_n):
-                commit = blocks[i + 1].last_commit
-                if commit.block_id != block_ids[i]:
-                    self._redo(blocks[i].header.height)
+        pipeline: "deque" = deque()  # submitted windows, oldest first
+        try:
+            while True:
+                cursor = (
+                    pipeline[-1]["next_height"]
+                    if pipeline
+                    else self.pool.height
+                )
+                entry = None
+                if len(pipeline) < self.pipeline_depth:
+                    entry = self._prep_window(cursor)
+                if isinstance(entry, dict):
+                    pipeline.append(entry)
+                    continue  # keep filling until depth / no window
+                if entry == "redo":
+                    # linkage broke at `cursor`'s window: its suffix is
+                    # already dropped from the pool, but the OLDER
+                    # windows in flight verified under intact linkage —
+                    # apply them before leaving
+                    self._drain(pipeline, apply=True)
                     return
-                entries.append((block_ids[i], blocks[i].header.height, commit))
+                if pipeline:
+                    # depth reached, or no next window yet: join the
+                    # oldest verdict and run its ABCI applies — this is
+                    # the overlap stage, younger windows are in flight
+                    if not self._join_and_apply(pipeline.popleft()):
+                        self._drain(pipeline, apply=False)
+                        return
+                    continue
+                if entry == "boundary":
+                    # valset changed at the very next block: verify it
+                    # alone via its successor's commit the slow way
+                    # (pipeline is empty here, so the valset is current)
+                    window = self.pool.peek(2)
+                    if len(window) < 2:
+                        return
+                    self._sync_one(window[0], window[1])
+                    continue
+                return  # nothing downloaded, nothing in flight
+        except BaseException:
+            # non-verification failure (app execution, dispatch layer):
+            # release the in-flight windows' queue slots, then let the
+            # sync routine's catch-all log and retry
+            self._drain(pipeline, apply=False)
+            raise
+
+    def _prep_window(self, cursor: int):
+        """Host-prep stage: claim up to VERIFY_WINDOW applyable blocks
+        at `cursor`, build part sets + block ids, check commit linkage,
+        and submit ONE batched commit verify to the dispatch queue.
+
+        Returns the in-flight window entry, None (no full window there
+        yet), "boundary" (valset changes at `cursor` — needs a drained
+        pipeline + `_sync_one`), or "redo" (linkage mismatch; the pool
+        suffix is already dropped)."""
+        window = self.pool.peek(VERIFY_WINDOW + 1, from_height=cursor)
+        if len(window) < 2:
+            return None
+        # the batch spans consecutive blocks under ONE valset — windows
+        # beyond an EndBlock valset rotation never enter the pipeline,
+        # their headers carry a different validators_hash
+        val_hash = self.state.validators.hash()
+        usable = 0
+        for b in window:
+            if b.header.validators_hash != val_hash:
+                break
+            usable += 1
+        if usable < 2:
+            return "boundary"
+        blocks = window[:usable]
+        # commit for blocks[i] rides in blocks[i+1].last_commit; the
+        # final block waits for its successor in a later window, so
+        # only the applied prefix needs part sets / ids built
+        apply_n = usable - 1
+        parts = [b.make_part_set() for b in blocks[:apply_n]]
+        block_ids = [
+            BlockID(b.hash(), ps.header)
+            for b, ps in zip(blocks[:apply_n], parts)
+        ]
+        entries = []
+        for i in range(apply_n):
+            commit = blocks[i + 1].last_commit
+            if commit.block_id != block_ids[i]:
+                self._redo(blocks[i].header.height)
+                return "redo"
+            entries.append((block_ids[i], blocks[i].header.height, commit))
+        try:
+            handle = self.state.validators.verify_commit_batched_async(
+                self.state.chain_id,
+                entries,
+                verifier=self.verifier,
+                queue=self._queue(),
+            )
+        except ValidationError:
+            # malformed commit caught during prep — same treatment as a
+            # failed verdict on this window
+            self._redo(blocks[0].header.height)
+            return "redo"
+        return {
+            "blocks": blocks,
+            "parts": parts,
+            "handle": handle,
+            "apply_n": apply_n,
+            "start_height": blocks[0].header.height,
+            "next_height": blocks[0].header.height + apply_n,
+        }
+
+    def _join_and_apply(self, entry) -> bool:
+        """Join one window's in-flight verdict, then store + apply its
+        blocks. False means the window failed and the pool suffix was
+        redone — the caller must discard younger in-flight windows."""
+        try:
+            entry["handle"].result()
+        except ValidationError:
+            self._redo(entry["start_height"])
+            return False
+        blocks, parts = entry["blocks"], entry["parts"]
+        for i in range(entry["apply_n"]):
+            commit = blocks[i + 1].last_commit
             try:
-                self.state.validators.verify_commit_batched(
-                    self.state.chain_id, entries, verifier=self.verifier
+                self.store.save_block(blocks[i], parts[i], commit)
+                apply_block(
+                    self.state,
+                    blocks[i],
+                    parts[i].header,
+                    self.app_conn,
+                    verifier=self.verifier,
+                    tx_indexer=self.tx_indexer,
+                    commit_preverified=True,
+                    hasher=self.hasher,
                 )
             except ValidationError:
-                self._redo(blocks[0].header.height)
-                return
-            for i in range(apply_n):
-                commit = blocks[i + 1].last_commit
+                # commit verified but the block body is inconsistent
+                # (possible only past a 2/3-byzantine signer set):
+                # drop the suffix + serving peer rather than spin
+                self._redo(blocks[i].header.height)
+                return False
+            self.pool.pop()
+            self.blocks_synced += 1
+            self._log_progress()
+        return True
+
+    def _drain(self, pipeline, apply: bool) -> None:
+        """Empty the pipeline in submission order. While `apply` holds
+        and windows keep verifying, their blocks go through ABCI; after
+        the first failure (or when draining a stale suffix) remaining
+        verdicts are joined ONLY to release their dispatch-queue slots —
+        stale blocks are never applied."""
+        while pipeline:
+            entry = pipeline.popleft()
+            if apply:
+                apply = self._join_and_apply(entry)
+            else:
                 try:
-                    self.store.save_block(blocks[i], parts[i], commit)
-                    apply_block(
-                        self.state,
-                        blocks[i],
-                        parts[i].header,
-                        self.app_conn,
-                        verifier=self.verifier,
-                        tx_indexer=self.tx_indexer,
-                        commit_preverified=True,
-                        hasher=self.hasher,
-                    )
-                except ValidationError:
-                    # commit verified but the block body is inconsistent
-                    # (possible only past a 2/3-byzantine signer set):
-                    # drop the suffix + serving peer rather than spin
-                    self._redo(blocks[i].header.height)
-                    return
-                self.pool.pop()
-                self.blocks_synced += 1
-                self._log_progress()
+                    entry["handle"].result()
+                except Exception:
+                    pass
 
     def _log_progress(self) -> None:
         """blocks/s every 100 blocks (reference `reactor.go:281-286`)."""

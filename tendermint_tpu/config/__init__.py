@@ -84,6 +84,10 @@ class StateSyncConfig:
     trust_period_s: float = 0.0  # 0 = no anchoring-header freshness check
     snapshot_interval: int = 0  # 0 = don't take/serve snapshots
     snapshot_keep_recent: int = 2
+    # Blocks a snapshot-serving node keeps below its head: after each
+    # snapshot the store prunes to head-retain_blocks+1 (0 = keep all).
+    # Peers needing older history state-sync + fast-sync the tail.
+    retain_blocks: int = 0
     chunk_size: int = 65536
     discovery_time_s: float = 3.0
     chunk_request_timeout_s: float = 10.0

@@ -60,6 +60,9 @@ from tendermint_tpu.utils import log as _log_mod
 import logging as _logging
 
 _SENTINEL = object()
+# receive-loop-internal marker: "no new input — join the oldest
+# in-flight vote-batch preverify instead"
+_JOIN = object()
 
 
 @dataclass
@@ -97,6 +100,7 @@ class ConsensusState:
         self.wal = WAL(wal_path, light=config.wal_light) if wal_path else None
 
         self._queue: "queue.Queue" = queue.Queue()
+        self._vote_dispatch = None  # lazy DispatchQueue for vote preverify
         self._mtx = threading.RLock()
         self._thread: threading.Thread | None = None
         self._running = False
@@ -171,6 +175,8 @@ class ConsensusState:
         self._queue.put(_SENTINEL)
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._vote_dispatch is not None:
+            self._vote_dispatch.close()
         if self.wal is not None:
             self.wal.close()
 
@@ -225,13 +231,40 @@ class ConsensusState:
     # 10k sigs one at a time on host while the TPU idles).
     VOTE_DRAIN_MIN = 8
     VOTE_DRAIN_MAX = 4096
+    # Vote-batch preverifies kept in flight: while batch K's signatures
+    # fly on device, the loop keeps pulling the queue and drains batch
+    # K+1 — verdicts join in drain order before ANY state mutation, so
+    # consensus input order is exactly the synchronous loop's.
+    VOTE_PIPELINE_DEPTH = int(
+        os.environ.get("TENDERMINT_TPU_VOTE_PIPELINE_DEPTH", "2")
+    )
 
     def _receive_loop(self) -> None:
+        from collections import deque
+
         stashed = None
+        pending: "deque" = deque()  # (records, handle) batches, drain order
         while self._running:
-            item = stashed if stashed is not None else self._queue.get()
-            stashed = None
+            if stashed is not None:
+                item, stashed = stashed, None
+            elif pending:
+                # a preverify is in flight: don't block on the queue —
+                # either drain more input behind it or join its verdict
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    item = _JOIN
+            else:
+                item = self._queue.get()
             if item is _SENTINEL:
+                # shutting down: join in-flight preverifies so their
+                # dispatch slots release; the votes are WAL'd and replay
+                # on restart, no state is mutated past this point
+                for _recs, handle in pending:
+                    try:
+                        handle.result()
+                    except Exception:
+                        pass
                 return
             # Opportunistic vote-storm drain: batch the CONSECUTIVE run of
             # queued votes for the same (height, round, type). Consensus
@@ -239,7 +272,8 @@ class ConsensusState:
             # the first non-matching item and stashes it for next turn.
             batch = None
             if (
-                isinstance(item, MsgRecord)
+                item is not _JOIN
+                and isinstance(item, MsgRecord)
                 and isinstance(item.msg, Vote)
                 and not self._queue.empty()
             ):
@@ -265,17 +299,29 @@ class ConsensusState:
             if batch is not None:
                 _metrics.VOTE_DRAIN_BATCH.observe(len(batch))
             try:
-                if batch is not None and len(batch) >= self.VOTE_DRAIN_MIN:
-                    # per-vote fault isolation must hold on this path too
-                    # (one equivocating vote must not drop its siblings)
-                    self._process_vote_batch(batch)
-                elif batch is not None:
-                    # runs too small to amortize a batch preverify take
-                    # the single-vote path, in drain order
-                    for rec in batch:
-                        self._process_item(rec)
+                if item is _JOIN:
+                    self._join_vote_batch(*pending.popleft())
+                elif batch is not None and len(batch) >= self.VOTE_DRAIN_MIN:
+                    # submit this run's preverify and keep pulling; the
+                    # depth bound joins the oldest batch first so state
+                    # mutation stays in drain order
+                    while len(pending) >= self.VOTE_PIPELINE_DEPTH:
+                        self._join_vote_batch(*pending.popleft())
+                    pending.append(self._submit_vote_batch(batch))
                 else:
-                    self._process_item(item)
+                    # ORDER BARRIER: anything that isn't a same-key vote
+                    # run (proposals, parts, timeouts, small runs) must
+                    # observe every earlier vote's effect — join all
+                    # in-flight batches before touching state
+                    while pending:
+                        self._join_vote_batch(*pending.popleft())
+                    if batch is not None:
+                        # runs too small to amortize a batch preverify
+                        # take the single-vote path, in drain order
+                        for rec in batch:
+                            self._process_item(rec)
+                    else:
+                        self._process_item(item)
             except (ErrDoubleSign, FatalConsensusError) as e:
                 # Internal failure: halt consensus rather than keep voting
                 # from a half-advanced state (reference PanicConsensus —
@@ -305,10 +351,17 @@ class ConsensusState:
             self._dispatch(item)
 
     def _process_vote_batch(self, records: list) -> None:
-        """One device batch verify for a drained same-key vote run, then
-        per-vote tallying with the verdict mask deciding which votes skip
-        the in-set signature check (failed lanes re-verify individually so
-        error attribution matches the single-vote path exactly)."""
+        """One batched verify for a drained same-key vote run, then
+        per-vote tallying — the submit+join pipeline stages run
+        back-to-back (kept for replay/tests; the receive loop overlaps
+        them)."""
+        self._join_vote_batch(*self._submit_vote_batch(records))
+
+    def _submit_vote_batch(self, records: list):
+        """Pipeline stage 1: WAL the drained run (drain order == WAL
+        order == eventual processing order), prep the signature triples
+        under the state lock, and launch their batch verify through the
+        dispatch queue. No round state is mutated here."""
         with self._mtx:
             if self.wal is not None:
                 for rec in records:
@@ -316,10 +369,26 @@ class ConsensusState:
                         self.wal.save(rec)
                     except Exception as e:
                         raise FatalConsensusError("WAL write failed") from e
-            verdicts = self._preverify_votes([rec.msg for rec in records])
+            handle = self._preverify_votes_async([rec.msg for rec in records])
+        return records, handle
+
+    def _join_vote_batch(self, records: list, handle) -> None:
+        """Pipeline stage 2: join the verdict mask, then tally each vote
+        with the mask deciding which skip the in-set signature check
+        (failed lanes re-verify individually so error attribution matches
+        the single-vote path exactly). A dispatch-layer failure degrades
+        to all-False — every vote just re-verifies in-set."""
+        try:
+            verdicts = handle.result()
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            verdicts = [False] * len(records)
+        with self._mtx:
             for rec, ok in zip(records, verdicts):
                 try:
-                    self._handle_vote(rec.msg, rec.peer_id, preverified=ok)
+                    self._handle_vote(rec.msg, rec.peer_id, preverified=bool(ok))
                 except (ErrDoubleSign, FatalConsensusError):
                     raise
                 except Exception:  # per-vote fault isolation, as singles
@@ -327,11 +396,22 @@ class ConsensusState:
 
                     traceback.print_exc()
 
-    def _preverify_votes(self, votes: list) -> list[bool]:
-        """Batch-verify signatures of current-height votes against the
-        current validator set; False lanes (or votes this can't cover:
-        other heights, bogus indices) fall back to individual verification
-        inside the vote set."""
+    def _vote_queue(self):
+        if self._vote_dispatch is None:
+            from tendermint_tpu.services.dispatch import DispatchQueue
+
+            self._vote_dispatch = DispatchQueue(
+                depth=max(2, self.VOTE_PIPELINE_DEPTH), name="consensus"
+            )
+        return self._vote_dispatch
+
+    def _preverify_votes_async(self, votes: list):
+        """Launch the batch preverify of current-height votes against
+        the current validator set; returns a handle resolving to the
+        per-vote bool list (False = re-verify individually in-set).
+        Triples are prepped NOW — the verdict stays valid however far
+        the loop advances before joining, because it binds the votes'
+        height to the valset current at that height."""
         verifier = self.verifier
         if verifier is None:
             from tendermint_tpu.services.verifier import default_verifier
@@ -349,11 +429,25 @@ class ConsensusState:
             )
             idxs.append(i)
         out = [False] * len(votes)
-        if triples:
-            verdicts = verifier.verify_batch(triples)
+        from tendermint_tpu.services.dispatch import CompletedHandle
+
+        if not triples:
+            return CompletedHandle(out)
+
+        def _scatter(verdicts):
             for i, ok in zip(idxs, verdicts):
                 out[i] = bool(ok)
-        return out
+            return out
+
+        if hasattr(verifier, "verify_batch_async"):
+            return verifier.verify_batch_async(
+                triples, queue=self._vote_queue()
+            ).then(_scatter)
+        return CompletedHandle(_scatter(verifier.verify_batch(triples)))
+
+    def _preverify_votes(self, votes: list) -> list[bool]:
+        """Synchronous preverify (replay/test seam): submit + join."""
+        return self._preverify_votes_async(votes).result()
 
     def _dispatch(self, item) -> None:
         if isinstance(item, MsgRecord):

@@ -219,6 +219,7 @@ class Node:
             on_synced=self._on_state_synced,
             hasher=hasher,
             snapshot_interval=cfg.statesync.snapshot_interval,
+            retain_blocks=cfg.statesync.retain_blocks,
             discovery_time_s=cfg.statesync.discovery_time_s,
             chunk_request_timeout_s=cfg.statesync.chunk_request_timeout_s,
             chunk_inflight_per_peer=cfg.statesync.chunk_inflight_per_peer,

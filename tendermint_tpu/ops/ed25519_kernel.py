@@ -544,18 +544,16 @@ def bucket_size(n: int) -> int:
     return size
 
 
-def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
-    """Verify a batch of ed25519 signatures on device; returns (B,) bool.
+def launch_batch_verify(pubkeys, msgs, sigs):
+    """Async half of `batch_verify`: host prep + device launch only.
 
-    Replaces the reference's sequential loop in
-    `types/validator_set.go:236-261` / `types/vote_set.go:137-196`.
-    Batches that pad to >= 1024 lanes take the Pallas ladder
-    (VMEM-resident accumulator, `ops.ed25519_ladder_pallas`) on TPU;
-    smaller ones the portable XLA scan.
+    Returns `(device_verdict, precheck, n)` with the verdict left
+    UN-materialized — JAX dispatch is asynchronous, so this call returns
+    as soon as the kernel is enqueued and the caller is free to do host
+    work (build the next window, apply the previous one) while the
+    device computes. `materialize_batch_verify` blocks on the transfer.
     """
     n = len(pubkeys)
-    if n == 0:
-        return np.zeros(0, dtype=bool)
     pub, r, s, h, precheck = prepare_batch(pubkeys, msgs, sigs)
     size = bucket_size(n)
     if size != n:
@@ -571,7 +569,27 @@ def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
     )
 
     if use_pallas_ladder(size):
-        verdict = np.asarray(verify_kernel_pallas(pub, r, s, h))[:n]
+        verdict = verify_kernel_pallas(pub, r, s, h)
     else:
-        verdict = np.asarray(verify_kernel(pub, r, s, h))[:n]
-    return verdict & precheck
+        verdict = verify_kernel(pub, r, s, h)
+    return verdict, precheck, n
+
+
+def materialize_batch_verify(launched) -> np.ndarray:
+    """Blocking half: pull the device verdict to host, mask prechecks."""
+    verdict, precheck, n = launched
+    return np.asarray(verdict)[:n] & precheck
+
+
+def batch_verify(pubkeys, msgs, sigs) -> np.ndarray:
+    """Verify a batch of ed25519 signatures on device; returns (B,) bool.
+
+    Replaces the reference's sequential loop in
+    `types/validator_set.go:236-261` / `types/vote_set.go:137-196`.
+    Batches that pad to >= 1024 lanes take the Pallas ladder
+    (VMEM-resident accumulator, `ops.ed25519_ladder_pallas`) on TPU;
+    smaller ones the portable XLA scan.
+    """
+    if len(pubkeys) == 0:
+        return np.zeros(0, dtype=bool)
+    return materialize_batch_verify(launch_batch_verify(pubkeys, msgs, sigs))

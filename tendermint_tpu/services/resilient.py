@@ -178,6 +178,64 @@ class _ResilientDispatch:
         metrics.DISPATCH_FALLBACK.labels(kind=self._kind).inc()
         return fallback_fn(*args, **kwargs)
 
+    def call_async(self, queue, launch_primary, finalize_primary, fallback_fn):
+        """Async analog of `call` for the dispatch pipeline: the launch
+        runs on the queue worker, the finalize at the consumer's join.
+
+        Breaker fallback propagates THROUGH the handle — a fault at
+        either stage (launch raise, or a device error surfacing at
+        materialization) records the failure and resolves the handle via
+        the host fallback instead of raising into the pipeline consumer.
+        One primary attempt, no in-call retries: a retry would stall
+        every launch queued behind this one; the breaker is the
+        cross-call policy."""
+        from tendermint_tpu.telemetry import metrics
+
+        def _fallback_now():
+            self.fallback_calls += 1
+            metrics.DISPATCH_FALLBACK.labels(kind=self._kind).inc()
+            return fallback_fn()
+
+        def _record_fault(stage: str, e: BaseException) -> None:
+            self._breaker.record_failure()
+            metrics.DISPATCH_FAILURES.labels(kind=self._kind).inc()
+            kv(
+                _log,
+                logging.WARNING,
+                f"{self._kind} async device dispatch failed",
+                kind=self._kind,
+                stage=stage,
+                error=f"{type(e).__name__}: {e}"[:120],
+                breaker=self._breaker.state,
+            )
+
+        def _launch():
+            if self._breaker.allow():
+                try:
+                    device_fail_point(self._kind)
+                    return ("primary", self._run_with_timeout(launch_primary, (), {}))
+                except Exception as e:
+                    _record_fault("launch", e)
+            return ("fallback", _fallback_now())
+
+        def _finalize(tagged):
+            tag, payload = tagged
+            if tag == "fallback":
+                return payload
+            try:
+                out = finalize_primary(payload)
+            except Exception as e:
+                # in-flight launch faulted: host re-verify, not an
+                # exception in the consumer
+                _record_fault("finalize", e)
+                return _fallback_now()
+            self._breaker.record_success()
+            self.primary_calls += 1
+            metrics.DISPATCH_PRIMARY.labels(kind=self._kind).inc()
+            return out
+
+        return queue.submit(_launch, _finalize, kind=self._kind)
+
     def snapshot(self) -> dict:
         out = self._breaker.snapshot()
         out.update(
@@ -229,6 +287,42 @@ class ResilientVerifier(BatchVerifier):
     def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
         return self._dispatch.call(
             self.primary.verify_batch, self.fallback.verify_batch, triples
+        )
+
+    def verify_batch_async(self, triples: Sequence[Triple], queue=None):
+        """Breaker-guarded async verify: the handle always resolves to
+        a verdict mask — a faulted in-flight launch re-verifies on host
+        at the join instead of raising into the pipeline."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return self._dispatch.call_async(
+            q,
+            lambda: self.primary.launch_verify_batch(triples),
+            self.primary.finalize_verify_batch,
+            lambda: self.fallback.verify_batch(triples),
+        )
+
+    def verify_commits_async(self, pubkeys, commits, queue=None, force_fused=None):
+        """Async commit-grid verify with the same guarantee: device
+        faults at launch OR materialization degrade to the host commit
+        loop inside the handle."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        if hasattr(self.primary, "launch_verify_commits"):
+            return self._dispatch.call_async(
+                q,
+                lambda: self.primary.launch_verify_commits(
+                    pubkeys, commits, force_fused=force_fused
+                ),
+                self.primary.finalize_verify_commits,
+                lambda: self._host_verify_commits(pubkeys, commits),
+            )
+        # primary without the commit-grid surface: host loop, but still
+        # on the queue worker so the submitter's host work overlaps
+        return q.submit(
+            lambda: self._host_verify_commits(pubkeys, commits), kind="verify"
         )
 
     def verify_commits(self, pubkeys, commits, force_fused=None):

@@ -66,6 +66,35 @@ class BatchVerifier:
     def verify_one(self, pubkey: bytes, msg: bytes, sig: bytes) -> bool:
         return bool(self.verify_batch([(pubkey, msg, sig)])[0])
 
+    # -- async seam (services/dispatch.py) ---------------------------------
+    #
+    # Backends split a verify into `launch` (host prep + device kernel
+    # dispatch — cheap, the device computes in the background) and
+    # `finalize` (materialize + mask — where np.asarray blocks). The
+    # base implementation has no device half, so launch does the whole
+    # verify — still useful: run on a DispatchQueue worker it overlaps
+    # with the submitter's host work.
+
+    def launch_verify_batch(self, triples: Sequence[Triple]):
+        return self.verify_batch(triples)
+
+    def finalize_verify_batch(self, launched) -> np.ndarray:
+        return launched
+
+    def verify_batch_async(self, triples: Sequence[Triple], queue=None):
+        """Submit a batch verify through a `DispatchQueue`; returns a
+        `VerifyHandle` whose `.result()` yields the same per-item
+        verdict mask `verify_batch` would. Device arrays stay
+        un-materialized until the join."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(
+            lambda: self.launch_verify_batch(triples),
+            self.finalize_verify_batch,
+            kind="verify",
+        )
+
 
 class HostBatchVerifier(BatchVerifier):
     """Sequential host-library backend (CPU baseline / TPU-free tests)."""
@@ -108,16 +137,34 @@ class DeviceBatchVerifier(BatchVerifier):
         )
 
     def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
+        return self.finalize_verify_batch(self.launch_verify_batch(triples))
+
+    def launch_verify_batch(self, triples: Sequence[Triple]):
+        """Host prep + device kernel dispatch; the verdict array stays
+        on device until `finalize_verify_batch` (sub-min batches answer
+        on host immediately — a single vote must never wait in a
+        pipeline behind a kernel launch)."""
         if not triples:
-            return np.zeros(0, dtype=bool)
+            return ("host", np.zeros(0, dtype=bool))
         if len(triples) < self._min_batch:
-            return self._host.verify_batch(triples)
-        from tendermint_tpu.ops.ed25519_kernel import batch_verify
+            return ("host", self._host.verify_batch(triples))
+        from tendermint_tpu.ops.ed25519_kernel import launch_batch_verify
 
         pubs, msgs, sigs = zip(*triples)
         t0 = time.perf_counter()
-        out = batch_verify(list(pubs), list(msgs), list(sigs))
-        _observe_verify("device", len(triples), time.perf_counter() - t0)
+        launched = launch_batch_verify(list(pubs), list(msgs), list(sigs))
+        return ("device", launched, t0)
+
+    def finalize_verify_batch(self, launched) -> np.ndarray:
+        if launched[0] == "host":
+            return launched[1]
+        from tendermint_tpu.ops.ed25519_kernel import materialize_batch_verify
+
+        _tag, payload, t0 = launched
+        out = materialize_batch_verify(payload)
+        # latency covers launch -> materialized (in-flight time included:
+        # that is what the pipeline hides, and what the sync path paid)
+        _observe_verify("device", len(out), time.perf_counter() - t0)
         return out
 
 
@@ -352,6 +399,18 @@ class TableBatchVerifier(DeviceBatchVerifier):
         `force_fused` overrides the fused-shaping decision (tests gate
         the chunk/pad logic on the CPU mesh with it); None = auto.
         """
+        return self.finalize_verify_commits(
+            self.launch_verify_commits(pubkeys, commits, force_fused=force_fused)
+        )
+
+    def launch_verify_commits(
+        self, pubkeys, commits, force_fused: bool | None = None
+    ):
+        """Async half of `verify_commits`: lane prep + one kernel launch
+        per chunk, device outputs left un-materialized (the fast-sync
+        pipeline preps/applies other windows while these fly). Paths
+        with no device half (small commits, table-build degradation)
+        compute on the spot and tag themselves "host"."""
         from tendermint_tpu.ops.ed25519_tables import (
             prepare_commit_lanes,
             verify_tables_kernel,
@@ -360,10 +419,10 @@ class TableBatchVerifier(DeviceBatchVerifier):
         n = len(pubkeys)
         k = len(commits)
         if n == 0 or k == 0:
-            return np.zeros((k, n), dtype=bool)
+            return ("host", np.zeros((k, n), dtype=bool))
         if k * n < self._min_batch:
             # small commits: host loop beats a device launch
-            return self._host_commit_loop(pubkeys, commits)
+            return ("host", self._host_commit_loop(pubkeys, commits))
         # malformed pubkeys degrade to a False verdict (matching every
         # other backend) instead of corrupting the packed table build
         length_ok = np.array([len(pk) == 32 for pk in pubkeys], dtype=bool)
@@ -378,7 +437,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
             # table construction is down and the set is too big to
             # host-build: answer this call with host crypto (slow but
             # correct) instead of raising out of the consensus path
-            return self._host_commit_loop(pubkeys, commits)
+            return ("host", self._host_commit_loop(pubkeys, commits))
         key_ok = key_ok & length_ok
         # The fused pallas path wants K in multiples of 8 (lane planes
         # are (8, 16K)) up to MAX_FUSED_STACK; pad with absent-vote
@@ -398,7 +457,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
             if force_fused is None
             else force_fused
         )
-        out_rows = []
+        launches = []  # (device_out, precheck, real, part_len) per chunk
         chunk = MAX_FUSED_STACK if fusable else len(commits)
         t0 = time.perf_counter()
         for lo in range(0, k, chunk):
@@ -408,11 +467,38 @@ class TableBatchVerifier(DeviceBatchVerifier):
                 absent = ([None] * n, [None] * n)
                 part.extend([absent] * (8 - real % 8))
             s, h, r, precheck = prepare_commit_lanes(pubkeys, part)
-            out = np.asarray(verify_tables_kernel(tables, s, h, r))
-            out = (out & precheck & np.tile(key_ok, len(part))).reshape(-1, n)
+            dev = verify_tables_kernel(tables, s, h, r)
+            launches.append((dev, precheck, real, len(part)))
+        return ("device", launches, key_ok, n, k, t0)
+
+    def finalize_verify_commits(self, launched) -> np.ndarray:
+        if launched[0] == "host":
+            return launched[1]
+        _tag, launches, key_ok, n, k, t0 = launched
+        out_rows = []
+        for dev, precheck, real, part_len in launches:
+            out = np.asarray(dev)
+            out = (out & precheck & np.tile(key_ok, part_len)).reshape(-1, n)
             out_rows.append(out[:real])
         _observe_verify("tables", k * n, time.perf_counter() - t0)
         return np.concatenate(out_rows, axis=0)
+
+    def verify_commits_async(
+        self, pubkeys, commits, queue=None, force_fused: bool | None = None
+    ):
+        """`verify_commits` through the dispatch queue: a VerifyHandle
+        resolving to the (K, N) verdict grid, kernels in flight until
+        the consumer joins."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(
+            lambda: self.launch_verify_commits(
+                pubkeys, commits, force_fused=force_fused
+            ),
+            self.finalize_verify_commits,
+            kind="verify",
+        )
 
     def _host_commit_loop(self, pubkeys, commits) -> np.ndarray:
         """Sequential host verification of commit-shaped lanes — the

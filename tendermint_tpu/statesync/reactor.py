@@ -272,6 +272,7 @@ class StateSyncReactor(Reactor):
         on_synced=None,
         hasher=None,
         snapshot_interval: int = 0,
+        retain_blocks: int = 0,
         discovery_time_s: float = 3.0,
         chunk_request_timeout_s: float = 10.0,
         chunk_inflight_per_peer: int = 4,
@@ -290,6 +291,7 @@ class StateSyncReactor(Reactor):
         self.on_synced = on_synced
         self.hasher = hasher
         self.snapshot_interval = snapshot_interval
+        self.retain_blocks = retain_blocks
         self.discovery_time_s = discovery_time_s
         self.chunk_request_timeout_s = chunk_request_timeout_s
         self.chunk_inflight_per_peer = chunk_inflight_per_peer
@@ -305,7 +307,13 @@ class StateSyncReactor(Reactor):
         self._active_key: tuple | None = None
         # commit_request correlation: height -> (event, [FullCommit|None])
         self._commit_waits: dict[int, tuple[threading.Event, list]] = {}
-        self._last_snapshot_height = 0
+        # Resume the snapshot cadence from the persisted store: a
+        # restarted serving node advertises its existing snapshots
+        # immediately and only re-takes once the interval elapses past
+        # the newest persisted height (instead of re-taking at the next
+        # interval as if it had never snapshotted).
+        manifests = snapshot_store.list_manifests()
+        self._last_snapshot_height = manifests[-1].height if manifests else 0
         self.restored_state = None  # set on successful restore; fast-sync
         # then advances it IN PLACE — read restored_manifest for the
         # height the snapshot itself landed at
@@ -435,7 +443,34 @@ class StateSyncReactor(Reactor):
             chunks=manifest.chunks,
             root=manifest.root.hex()[:12],
         )
+        self._maybe_prune_blocks(height)
         return manifest
+
+    def _maybe_prune_blocks(self, height: int) -> None:
+        """Retention-driven `BlockStore.prune` ([statesync]
+        retain_blocks): runs right AFTER a snapshot lands so the chunked
+        payload — not the block store — carries the history peers need;
+        the store keeps a bounded `retain_blocks` tail for fast-sync
+        serving and the next snapshot's block tail."""
+        if self.retain_blocks <= 0 or not hasattr(self.block_store, "prune"):
+            return
+        retain_height = height - self.retain_blocks + 1
+        if retain_height <= 1:
+            return
+        try:
+            pruned = self.block_store.prune(retain_height)
+        except Exception:
+            logging.getLogger(__name__).exception("block-store prune failed")
+            return
+        if pruned:
+            kv(
+                _log,
+                logging.INFO,
+                "pruned block store",
+                retain_height=retain_height,
+                pruned=pruned,
+                base=getattr(self.block_store, "base", None),
+            )
 
     # -- syncing side: message handling ------------------------------------
 

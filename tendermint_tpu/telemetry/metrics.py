@@ -125,6 +125,33 @@ DISPATCH_FAILURES = Counter(
     labelnames=("kind",),
 )
 
+# -- async dispatch pipeline (services/dispatch.py) ---------------------------
+#
+# `queue` labels are the pipeline owners ("fastsync", "consensus",
+# "default") — a fixed small set, never per-peer/per-height.
+
+DISPATCH_INFLIGHT = Gauge(
+    "tendermint_dispatch_inflight",
+    "Launches submitted to a dispatch queue and not yet joined",
+    labelnames=("queue",),
+)
+DISPATCH_QUEUE_WAIT = Histogram(
+    "tendermint_dispatch_queue_wait_seconds",
+    "Time a launch waited in the dispatch queue before starting",
+    labelnames=("queue",),
+    buckets=LATENCY_BUCKETS,
+)
+# Per-handle share of submit->join wall time the consumer spent doing
+# other work (host prep, ABCI applies) instead of blocked in result().
+# 0 = fully synchronous behavior; anything > 0 proves the overlap
+# pipeline engaged (tools/bench_hotpath.py fastsync_pipeline section).
+DISPATCH_OVERLAP = Histogram(
+    "tendermint_dispatch_overlap_ratio",
+    "Fraction of a dispatch handle's lifetime overlapped with host work",
+    labelnames=("queue",),
+    buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0),
+)
+
 # Pre-seed the known breaker kinds and round-skip phases so scrapes see
 # zero-valued series before (or without) any instance/event — Prometheus
 # convention: known label values start at 0, absence means "unknown".

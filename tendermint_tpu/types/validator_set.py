@@ -238,24 +238,106 @@ class ValidatorSet:
         if hasattr(verifier, "verify_commits") and any(
             triples for triples, _ in collected
         ):
-            lanes: list[tuple[list, list]] = []
-            for triples, indices in collected:
-                msgs: list[bytes | None] = [None] * n
-                sigs: list[bytes | None] = [None] * n
-                for (pk, msg, sig), idx in zip(triples, indices):
-                    msgs[idx], sigs[idx] = msg, sig
-                lanes.append((msgs, sigs))
             grid = verifier.verify_commits(
-                [v.pub_key.data for v in self.validators], lanes
+                [v.pub_key.data for v in self.validators],
+                self._commit_lanes(collected, n),
             )
-            ok_by_entry = [
-                [bool(grid[ei][i]) for i in indices]
-                for ei, (_, indices) in enumerate(collected)
-            ]
+            ok_by_entry = self._grid_to_entry_oks(grid, collected)
         else:
             ok_by_entry = [
                 _verify_triples(triples, verifier) for triples, _ in collected
             ]
+        self._tally_commit_verdicts(entries, collected, ok_by_entry)
+
+    def verify_commit_batched_async(
+        self,
+        chain_id: str,
+        entries: list[tuple[BlockID, int, "object"]],
+        verifier=None,
+        queue=None,
+    ):
+        """Pipelined `verify_commit_batched`: lane prep + device submit
+        happen NOW (the caller's host-prep stage), the quorum tally —
+        and any ValidationError — at the returned handle's `.result()`.
+
+        Malformed commits (size/height/round mismatches) still raise
+        synchronously here, before anything is launched: the fast-sync
+        pipeline treats that exactly like a failed verdict. Verifiers
+        without an async surface verify inline and hand back an
+        already-resolved handle, so callers stay uniform.
+        """
+        if verifier is None:
+            from tendermint_tpu.services.verifier import default_verifier
+
+            verifier = default_verifier()
+        collected = [
+            self._collect_commit_sigs(chain_id, bid, h, c)
+            for bid, h, c in entries
+        ]
+        n = len(self.validators)
+
+        if hasattr(verifier, "verify_commits_async") and any(
+            triples for triples, _ in collected
+        ):
+            handle = verifier.verify_commits_async(
+                [v.pub_key.data for v in self.validators],
+                self._commit_lanes(collected, n),
+                queue=queue,
+            )
+
+            def _tally_grid(grid):
+                self._tally_commit_verdicts(
+                    entries, collected, self._grid_to_entry_oks(grid, collected)
+                )
+                return True
+
+            return handle.then(_tally_grid)
+        if hasattr(verifier, "verify_batch_async"):
+            flat = [t for triples, _ in collected for t in triples]
+            handle = verifier.verify_batch_async(flat, queue=queue)
+
+            def _tally_flat(mask):
+                ok_by_entry, at = [], 0
+                for triples, _ in collected:
+                    ok_by_entry.append(
+                        [bool(v) for v in mask[at : at + len(triples)]]
+                    )
+                    at += len(triples)
+                self._tally_commit_verdicts(entries, collected, ok_by_entry)
+                return True
+
+            return handle.then(_tally_flat)
+        from tendermint_tpu.services.dispatch import CompletedHandle
+
+        try:
+            self.verify_commit_batched(chain_id, entries, verifier)
+        except ValidationError as e:
+            return CompletedHandle(exc=e)
+        return CompletedHandle(True)
+
+    @staticmethod
+    def _commit_lanes(collected, n: int) -> list[tuple[list, list]]:
+        """Triples+indices -> validator-index-aligned (msgs, sigs) lanes
+        for the commit-grid verifiers (cached comb tables)."""
+        lanes: list[tuple[list, list]] = []
+        for triples, indices in collected:
+            msgs: list[bytes | None] = [None] * n
+            sigs: list[bytes | None] = [None] * n
+            for (pk, msg, sig), idx in zip(triples, indices):
+                msgs[idx], sigs[idx] = msg, sig
+            lanes.append((msgs, sigs))
+        return lanes
+
+    @staticmethod
+    def _grid_to_entry_oks(grid, collected) -> list[list[bool]]:
+        return [
+            [bool(grid[ei][i]) for i in indices]
+            for ei, (_, indices) in enumerate(collected)
+        ]
+
+    def _tally_commit_verdicts(self, entries, collected, ok_by_entry) -> None:
+        """Shared quorum walk: raises naming the failing validator (and
+        entry, when K > 1), else requires >2/3 power per entry."""
         for ei, ((block_id, height, commit), (_, indices), oks) in enumerate(
             zip(entries, collected, ok_by_entry)
         ):

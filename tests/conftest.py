@@ -18,3 +18,30 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def lint_kernel_marks(items) -> list[str]:
+    """Marker lint: every `kernel`-marked test must ALSO be `slow`.
+
+    Tier-1 selects `-m 'not slow'`, which OVERRIDES pytest.ini's
+    `-m 'not kernel'` — a kernel-only mark would pull ~20 min of XLA:CPU
+    kernel compiles into the fast lane and time the whole run out
+    (ROADMAP tier-1 note). Returns offending node ids."""
+    return [
+        item.nodeid
+        for item in items
+        if item.get_closest_marker("kernel") is not None
+        and item.get_closest_marker("slow") is None
+    ]
+
+
+def pytest_collection_modifyitems(config, items):
+    bad = lint_kernel_marks(items)
+    if bad:
+        raise pytest.UsageError(
+            "kernel-marked tests missing the slow mark (tier-1 `-m 'not "
+            "slow'` would compile their XLA:CPU kernels): "
+            + ", ".join(sorted(bad)[:10])
+        )

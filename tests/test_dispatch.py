@@ -1,0 +1,352 @@
+"""Async dispatch pipeline: submission-order guarantees, bounded-depth
+backpressure, and breaker fallback propagating through in-flight handles
+(services/dispatch.py + the verify-spine async entry points).
+
+The ordering contract under test: for handles H1, H2 submitted in that
+order to one DispatchQueue, H1's launch starts before H2's, and a
+consumer joining in submission order observes verdicts in submission
+order — including when device faults injected mid-pipeline
+(TENDERMINT_TPU_DEVICE_FAIL) swap individual launches onto the host
+fallback path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.services import dispatch as dispatch_mod
+from tendermint_tpu.services.dispatch import (
+    ChainedHandle,
+    CompletedHandle,
+    DispatchQueue,
+    VerifyHandle,
+)
+from tendermint_tpu.services.resilient import ResilientVerifier
+from tendermint_tpu.services.verifier import (
+    BatchVerifier,
+    DeviceBatchVerifier,
+    HostBatchVerifier,
+    TableBatchVerifier,
+)
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.circuit import OPEN, CircuitBreaker
+
+from tests.helpers import det_priv_keys
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fail.clear_device_faults()
+    yield
+    fail.clear_device_faults()
+
+
+def _triples(n, corrupt=()):
+    keys = det_priv_keys(n)
+    out = []
+    for i, k in enumerate(keys):
+        msg = bytes([i]) * 8
+        sig = k.sign(msg)
+        if i in corrupt:
+            sig = sig[:10] + bytes([sig[10] ^ 0xFF]) + sig[11:]
+        out.append((k.pub_key.data, msg, sig))
+    return out
+
+
+class TestDispatchQueue:
+    def test_fifo_launch_order_and_results(self):
+        q = DispatchQueue(depth=8, name="t-fifo")
+        order = []
+        handles = [
+            q.submit(lambda i=i: (order.append(i), i * 10)[1]) for i in range(8)
+        ]
+        assert [h.result() for h in handles] == [i * 10 for i in range(8)]
+        assert order == list(range(8))  # launched strictly in submission order
+
+    def test_depth_bounds_inflight_and_submit_blocks(self):
+        q = DispatchQueue(depth=2, name="t-depth")
+        gate = threading.Event()
+        h1 = q.submit(gate.wait)
+        h2 = q.submit(lambda: "second")
+        third_submitted = threading.Event()
+
+        def submit_third():
+            h = q.submit(lambda: "third")
+            third_submitted.set()
+            h.result()
+
+        t = threading.Thread(target=submit_third, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        # both slots held by unjoined handles: the third submit blocks
+        assert not third_submitted.is_set()
+        assert q.inflight() == 2
+        gate.set()
+        h1.result()  # join frees a slot -> third submit proceeds
+        assert third_submitted.wait(5)
+        assert h2.result() == "second"
+        t.join(timeout=5)
+        assert q.inflight() == 0
+
+    def test_stalled_queue_raises_instead_of_wedging(self, monkeypatch):
+        monkeypatch.setattr(dispatch_mod, "_STALL_TIMEOUT_S", 0.05)
+        q = DispatchQueue(depth=1, name="t-stall")
+        q.submit(lambda: 1)  # never joined
+        with pytest.raises(RuntimeError, match="stalled"):
+            q.submit(lambda: 2)
+
+    def test_launch_exception_delivered_at_result_and_cached(self):
+        q = DispatchQueue(depth=2, name="t-exc")
+
+        def boom():
+            raise ValueError("kernel exploded")
+
+        h = q.submit(boom)
+        for _ in range(2):  # result() idempotent: cached error re-raises
+            with pytest.raises(ValueError, match="kernel exploded"):
+                h.result()
+        # the failed handle released its slot: the queue keeps working
+        assert q.submit(lambda: 7).result() == 7
+
+    def test_finalize_runs_on_joining_thread(self):
+        q = DispatchQueue(depth=2, name="t-fin")
+        threads = {}
+
+        def launch():
+            threads["launch"] = threading.current_thread().name
+            return 3
+
+        def finalize(v):
+            threads["finalize"] = threading.current_thread().name
+            return v + 1
+
+        assert q.submit(launch, finalize).result() == 4
+        assert threads["launch"].startswith("dispatch-")
+        assert threads["finalize"] == threading.current_thread().name
+
+    def test_then_chains_and_caches(self):
+        q = DispatchQueue(depth=2, name="t-then")
+        calls = []
+
+        def tally(v):
+            calls.append(v)
+            return v * 2
+
+        h = q.submit(lambda: 21).then(tally)
+        assert isinstance(h, ChainedHandle)
+        assert h.result() == 42
+        assert h.result() == 42
+        assert calls == [21]  # mapping ran once
+        # chained over a failure: the parent's exception propagates
+        h2 = q.submit(lambda: (_ for _ in ()).throw(RuntimeError("x"))).then(tally)
+        with pytest.raises(RuntimeError):
+            h2.result()
+
+    def test_completed_handle(self):
+        assert CompletedHandle(5).result() == 5
+        assert CompletedHandle(5).done()
+        assert CompletedHandle(5).then(lambda v: v + 1).result() == 6
+        with pytest.raises(KeyError):
+            CompletedHandle(exc=KeyError("k")).result()
+
+    def test_close_rejects_new_submits(self):
+        q = DispatchQueue(depth=2, name="t-close")
+        h = q.submit(lambda: 1)
+        q.close()
+        assert h.result() == 1  # in-flight stays joinable
+        with pytest.raises(RuntimeError, match="closed"):
+            q.submit(lambda: 2)
+
+    def test_inflight_gauge_and_overlap_metric(self):
+        from tendermint_tpu.telemetry import REGISTRY
+
+        q = DispatchQueue(depth=3, name="t-metrics")
+        h = q.submit(lambda: time.sleep(0.02) or 1)
+        time.sleep(0.005)  # overlapped host work
+        assert h.result() == 1
+        gauge = REGISTRY.get("tendermint_dispatch_inflight")
+        assert gauge.labels(queue="t-metrics").value == 0
+        hist = REGISTRY.get("tendermint_dispatch_overlap_ratio")
+        assert hist.labels(queue="t-metrics").value["count"] >= 1
+
+
+class TestAsyncVerifierSurface:
+    def test_host_verifier_async_matches_sync(self):
+        v = HostBatchVerifier()
+        triples = _triples(6, corrupt=(2, 4))
+        q = DispatchQueue(depth=2, name="t-host")
+        got = v.verify_batch_async(triples, queue=q).result()
+        np.testing.assert_array_equal(got, v.verify_batch(triples))
+
+    def test_device_verifier_small_batch_async(self):
+        # below min_device_batch the launch answers on host immediately;
+        # the handle must still behave like any other
+        v = DeviceBatchVerifier(min_device_batch=10**6)
+        triples = _triples(5, corrupt=(1,))
+        got = v.verify_batch_async(triples, queue=DispatchQueue(depth=2)).result()
+        assert list(got) == [True, False, True, True, True]
+
+    def test_table_verifier_commits_async_matches_sync(self):
+        v = TableBatchVerifier(min_device_batch=10**6)  # host path, no compile
+        keys = det_priv_keys(4)
+        pubs = [k.pub_key.data for k in keys]
+        msgs = [bytes([i]) * 8 for i in range(4)]
+        # commit-lane shape: (msgs, sigs) per commit
+        lanes = [(msgs, [k.sign(m) for k, m in zip(keys, msgs)])]
+        sync = v.verify_commits(pubs, lanes)
+        got = v.verify_commits_async(
+            pubs, lanes, queue=DispatchQueue(depth=2)
+        ).result()
+        np.testing.assert_array_equal(got, sync)
+        assert got.all()
+
+
+class TestBreakerThroughHandles:
+    """`ResilientVerifier` fallback must resolve THROUGH the handle — a
+    faulted in-flight launch re-verifies on host at the join, never
+    raising into the pipeline consumer."""
+
+    def test_faulted_launch_resolves_via_host_fallback(self):
+        v = ResilientVerifier(
+            DeviceBatchVerifier(min_device_batch=10**6),
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60),
+        )
+        triples = _triples(6, corrupt=(0, 3))
+        fail.set_device_fault("verify", 1)
+        q = DispatchQueue(depth=2, name="t-fault")
+        got = v.verify_batch_async(triples, queue=q).result()  # must not raise
+        expect = HostBatchVerifier().verify_batch(triples)
+        np.testing.assert_array_equal(got, expect)
+        assert v.breaker.state == OPEN
+        assert v._dispatch.fallback_calls >= 1
+
+    def test_finalize_fault_resolves_via_host_fallback(self):
+        class _MaterializeBomb(BatchVerifier):
+            def launch_verify_batch(self, triples):
+                return triples  # launch "succeeds"
+
+            def finalize_verify_batch(self, launched):
+                raise RuntimeError("transfer died mid-flight")
+
+            def verify_batch(self, triples):
+                return self.finalize_verify_batch(self.launch_verify_batch(triples))
+
+        v = ResilientVerifier(
+            _MaterializeBomb(),
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60),
+        )
+        triples = _triples(4, corrupt=(2,))
+        got = v.verify_batch_async(triples, queue=DispatchQueue(depth=2)).result()
+        np.testing.assert_array_equal(
+            got, HostBatchVerifier().verify_batch(triples)
+        )
+        assert v.breaker.state == OPEN
+
+    def test_verdicts_join_in_submission_order_under_mid_pipeline_faults(self):
+        """THE ordering test: several batches in flight on one queue, a
+        bounded fault budget knocking out launches mid-pipeline; every
+        verdict must come back correct and in submission order."""
+        v = ResilientVerifier(
+            DeviceBatchVerifier(min_device_batch=10**6),
+            breaker=CircuitBreaker(failure_threshold=100, reset_timeout_s=60),
+        )
+        q = DispatchQueue(depth=3, name="t-order")
+        host = HostBatchVerifier()
+        # batch i corrupts lane i -> each batch has a DISTINCT verdict
+        # mask, so any reordering is visible in the joined results
+        batches = [_triples(6, corrupt=(i,)) for i in range(6)]
+        fail.set_device_fault("verify", 2)  # faults land mid-pipeline
+        handles = []
+        for i, triples in enumerate(batches):
+            if i >= q.depth:
+                got = handles[i - q.depth][1].result()  # join oldest first
+                np.testing.assert_array_equal(
+                    got, host.verify_batch(batches[i - q.depth])
+                )
+            handles.append((i, v.verify_batch_async(triples, queue=q)))
+        for i, h in handles:
+            got = h.result()  # idempotent for already-joined handles
+            np.testing.assert_array_equal(got, host.verify_batch(batches[i]))
+            assert not got[i] and got.sum() == 5  # the batch's own mask
+        assert v._dispatch.fallback_calls == 2  # both injected faults degraded
+
+    def test_commit_grid_fault_degrades_to_host_loop(self):
+        v = ResilientVerifier(
+            TableBatchVerifier(min_device_batch=10**6),
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60),
+        )
+        keys = det_priv_keys(4)
+        pubs = [k.pub_key.data for k in keys]
+        msgs = [bytes([i]) * 8 for i in range(4)]
+        sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+        bad = list(sigs)
+        bad[1] = bytes(64)
+        lanes = [(msgs, sigs), (msgs, bad)]
+        fail.set_device_fault("verify")
+        got = v.verify_commits_async(
+            pubs, lanes, queue=DispatchQueue(depth=2)
+        ).result()
+        assert got.shape == (2, 4)
+        assert got[0].all()
+        assert not got[1][1] and got[1].sum() == 3
+        assert v.breaker.state == OPEN
+
+
+class TestVotePipelineOrdering:
+    def test_preverify_handles_join_in_drain_order(self):
+        """The consensus drain submits batch K+1 while K is in flight;
+        verdict masks must map back to their own batches when joined in
+        drain order (the receive loop's only join order)."""
+        from tests.helpers import make_block_id, make_validators, signed_vote
+        from tendermint_tpu.types import VOTE_TYPE_PREVOTE
+
+        vals, privs = make_validators(4)
+        bid = make_block_id()
+
+        class _CS:
+            """Just enough ConsensusState surface for _preverify_votes_async."""
+
+            VOTE_PIPELINE_DEPTH = 2
+            _vote_dispatch = None
+            verifier = HostBatchVerifier()
+
+            class _State:
+                chain_id = "test-chain"
+
+            def __init__(self):
+                from tendermint_tpu.consensus.state import ConsensusState
+
+                self.state = self._State()
+                self.validators = vals
+                self.height = 1
+                self._vote_queue = ConsensusState._vote_queue.__get__(self)
+                self._preverify_votes_async = (
+                    ConsensusState._preverify_votes_async.__get__(self)
+                )
+
+        cs = _CS()
+
+        def run_votes(r):
+            votes = [
+                signed_vote(p, i, 1, r, VOTE_TYPE_PREVOTE, bid)
+                for i, p in enumerate(privs)
+            ]
+            if r == 1:
+                votes[2] = votes[2].with_signature(bytes(64))  # distinct mask
+            return votes
+
+        # the receive loop's join discipline: the oldest batch joins
+        # before a submit would exceed the pipeline depth
+        pending, masks = [], []
+        for r in range(3):
+            if len(pending) >= cs.VOTE_PIPELINE_DEPTH:
+                masks.append(pending.pop(0).result())
+            pending.append(cs._preverify_votes_async(run_votes(r)))
+        masks.extend(h.result() for h in pending)
+        assert masks[0] == [True, True, True, True]
+        assert masks[1] == [True, True, False, True]
+        assert masks[2] == [True, True, True, True]

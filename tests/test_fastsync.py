@@ -121,6 +121,148 @@ class TestBlockPool:
         assert len(pool.peek(3)) == 1  # height 1 survives
 
 
+def _pipelined_reactor(sim: ChainSim, depth=2, verifier=None, app=None):
+    """A fresh fast-syncing reactor with `sim`'s whole chain pre-loaded
+    into its pool (the bench/ordering harness: drive `_try_sync`
+    directly, no network, so pipeline drains are deterministic)."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+
+    fresh_state = make_genesis_state(MemDB(), sim.genesis)
+    fresh_state.save()
+    store = BlockStore(MemDB())
+    conns = local_client_creator(app if app is not None else KVStoreApp())()
+    reactor = BlockchainReactor(
+        state=fresh_state,
+        store=store,
+        app_conn=conns.consensus,
+        fast_sync=True,
+        verifier=verifier,
+        pipeline_depth=depth,
+    )
+    reactor.pool.set_peer_height("srv", len(sim.blocks))
+    for h, b in enumerate(sim.blocks, start=1):
+        reactor.pool._blocks[h] = (b, "srv")
+    return reactor, fresh_state, store
+
+
+class TestFastSyncPipeline:
+    """Software-pipeline ordering: while window K's verdict is in
+    flight, K+1 preps and K-1 applies — and any redo / verdict failure
+    / valset boundary must drain the in-flight suffix WITHOUT applying
+    stale blocks (ISSUE 4 acceptance)."""
+
+    def test_pipelined_sync_applies_full_chain(self):
+        sim = ChainSim(n_vals=4)
+        for _ in range(48):
+            sim.advance()
+        for depth in (1, 2, 3):
+            reactor, state, store = _pipelined_reactor(sim, depth=depth)
+            reactor._try_sync()
+            assert store.height == 47, f"depth {depth}"
+            assert state.last_block_height == 47
+            for h in (1, 20, 47):
+                assert store.load_block(h).hash() == sim.blocks[h - 1].hash()
+
+    def test_linkage_break_mid_pipeline_applies_intact_prefix_only(self):
+        """Window 2's commit linkage breaks while window 1 is in
+        flight: window 1 (verified under intact linkage) must still
+        apply; the broken suffix must be dropped un-applied."""
+        from tests.helpers import make_block_id
+
+        sim = ChainSim(n_vals=4)
+        for _ in range(40):
+            sim.advance()
+        # blocks[20] (height 21) carries height 20's commit; point it at
+        # a wrong block so window-2 prep hits the linkage mismatch
+        import dataclasses
+
+        bad = dataclasses.replace(
+            sim.blocks[20].last_commit, block_id=make_block_id(b"forged")
+        )
+        sim.blocks[20] = dataclasses.replace(sim.blocks[20], last_commit=bad)
+        reactor, _state, store = _pipelined_reactor(sim, depth=2)
+        reactor._try_sync()
+        # window 1 = heights 1..17 peeked, 16 applied; the redo at
+        # height 20 dropped the pool suffix before it could ever apply
+        assert store.height == 16
+        assert store.load_block(20) is None
+        assert reactor.pool.height == 17
+        # the bad suffix is gone from the pool: nothing stale remains
+        assert all(b.header.height < 20 for b in reactor.pool.peek(50))
+
+    def test_forged_verdict_mid_pipeline_drains_without_applying(self):
+        """Window 2's commit signatures are forged: its verdict fails at
+        the JOIN (after younger windows were already submitted) — the
+        older window applies, the failed one and everything behind it
+        drain un-applied."""
+        sim = ChainSim(n_vals=4)
+        for _ in range(40):
+            sim.advance()
+        # forge quorum-breaking signatures in height 20's commit (rides
+        # in blocks[20].last_commit); linkage stays intact so the fault
+        # surfaces at verdict-join time, not prep time
+        commit = sim.blocks[20].last_commit
+        for i in range(3):
+            commit.precommits[i] = commit.precommits[i].with_signature(bytes(64))
+        reactor, _state, store = _pipelined_reactor(sim, depth=2)
+        reactor._try_sync()
+        assert store.height == 16  # window 1 applied, window 2 rejected
+        assert store.load_block(17) is None
+        assert store.load_block(20) is None
+
+    def test_valset_rotation_boundary_drains_and_crosses(self):
+        """A validator-power rotation mid-chain: pipelined windows never
+        span the boundary (validators_hash changes), the pipeline drains,
+        `_sync_one` walks the boundary block, and sync continues under
+        the new set to the chain head."""
+        from tendermint_tpu.abci.apps import PersistentKVStoreApp
+
+        sim = ChainSim(n_vals=4, app=PersistentKVStoreApp())
+        for _ in range(20):
+            sim.advance()
+        pub = sim.state.validators.validators[0].pub_key.data.hex()
+        sim.advance(txs=[f"val:{pub}/25".encode()])  # height 21 rotates power
+        assert sim.state.validators.hash() != sim.blocks[0].header.validators_hash
+        for _ in range(19):
+            sim.advance()
+        reactor, state, store = _pipelined_reactor(
+            sim, depth=2, app=PersistentKVStoreApp()
+        )
+        reactor._try_sync()
+        assert store.height == 39
+        assert state.validators.hash() == sim.state.validators.hash()
+
+    def test_device_faults_mid_pipeline_fall_back_in_order(self):
+        """TENDERMINT_TPU_DEVICE_FAIL mid-pipeline: faulted in-flight
+        window launches resolve via host re-verify inside their handles
+        and the sync completes — every apply in height order (any
+        reorder would break the app_hash/validators_hash lineage and
+        stall the sync short of the head)."""
+        from tendermint_tpu.services.resilient import ResilientVerifier
+        from tendermint_tpu.services.verifier import TableBatchVerifier
+        from tendermint_tpu.utils import fail
+        from tendermint_tpu.utils.circuit import CircuitBreaker
+
+        sim = ChainSim(n_vals=4)
+        for _ in range(48):
+            sim.advance()
+        verifier = ResilientVerifier(
+            TableBatchVerifier(min_device_batch=10**6),
+            breaker=CircuitBreaker(failure_threshold=100, reset_timeout_s=60),
+        )
+        fail.clear_device_faults()
+        fail.set_device_fault("verify", 2)  # first two window launches fault
+        try:
+            reactor, _state, store = _pipelined_reactor(
+                sim, depth=2, verifier=verifier
+            )
+            reactor._try_sync()
+        finally:
+            fail.clear_device_faults()
+        assert store.height == 47
+        assert verifier._dispatch.fallback_calls == 2
+
+
 def _serving_node(sim: ChainSim, store: BlockStore):
     """A node that serves `store` over the blockchain channel."""
     sw = Switch(NodeInfo(node_id="server", moniker="server", chain_id=CHAIN))

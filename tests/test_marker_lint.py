@@ -1,0 +1,39 @@
+"""The collection-time marker lint (tests/conftest.py): a `kernel` mark
+without a `slow` mark would let tier-1's `-m 'not slow'` selection pull
+~20-minute XLA:CPU kernel compiles into the fast lane — the lint fails
+collection before that can land (ROADMAP tier-1 note)."""
+
+import pytest
+
+from tests.conftest import lint_kernel_marks
+
+
+class _FakeItem:
+    def __init__(self, nodeid, marks):
+        self.nodeid = nodeid
+        self._marks = set(marks)
+
+    def get_closest_marker(self, name):
+        return object() if name in self._marks else None
+
+
+def test_kernel_without_slow_is_flagged():
+    items = [
+        _FakeItem("tests/test_a.py::test_compiles", {"kernel"}),
+        _FakeItem("tests/test_b.py::test_ok", {"kernel", "slow"}),
+        _FakeItem("tests/test_c.py::test_plain", set()),
+        _FakeItem("tests/test_d.py::test_slow_only", {"slow"}),
+    ]
+    assert lint_kernel_marks(items) == ["tests/test_a.py::test_compiles"]
+
+
+def test_clean_suite_passes():
+    assert lint_kernel_marks([_FakeItem("x::t", {"kernel", "slow"})]) == []
+
+
+def test_collection_hook_raises_usage_error():
+    import tests.conftest as conftest
+
+    bad = [_FakeItem("tests/test_a.py::test_compiles", {"kernel"})]
+    with pytest.raises(pytest.UsageError, match="missing the slow mark"):
+        conftest.pytest_collection_modifyitems(None, bad)

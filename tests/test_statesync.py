@@ -612,3 +612,87 @@ class TestStateSyncEndToEnd:
                 "fallback fast-sync from genesis",
             )
             assert joiner.node.statesync_reactor.restored_state is None
+
+
+class TestServingLifecycle:
+    """Snapshot-serving node lifecycle: restart resumes the persisted
+    cadence (no early re-take, snapshots advertised immediately) and
+    `[statesync] retain_blocks` bounds the block store after each take."""
+
+    def _reactor(self, snap_store, block_store, state, **kw):
+        from tendermint_tpu.statesync.reactor import StateSyncReactor
+
+        return StateSyncReactor(snap_store, block_store, state, **kw)
+
+    def test_restart_resumes_snapshot_cadence(self):
+        from tests.helpers import ChainSim
+
+        sim = ChainSim(n_vals=4)
+        store = BlockStore(MemDB())
+
+        def advance_to(height):
+            while store.height < height:
+                block = sim.advance()
+                store.save_block(block, block.make_part_set(), sim.commits[-1])
+
+        advance_to(10)
+        db = MemDB()
+        first = self._reactor(
+            SnapshotStore(db, hasher=HOST_HASHER, chunk_size=64),
+            store,
+            sim.state,
+            snapshot_interval=5,
+        )
+        assert first.maybe_take_snapshot(sim.state, app=sim.app) is not None
+
+        # rebuild over the SAME db (the restart): the boot-time store
+        # scan must find the persisted snapshot — advertised immediately
+        # — and resume the take cadence from height 10
+        reborn = SnapshotStore(db, hasher=HOST_HASHER, chunk_size=64)
+        assert [m.height for m in reborn.list_manifests()] == [10]
+        reactor = self._reactor(reborn, store, sim.state, snapshot_interval=5)
+        assert reactor._last_snapshot_height == 10
+        advance_to(12)  # interval not elapsed: no early re-take
+        assert reactor.maybe_take_snapshot(sim.state, app=sim.app) is None
+        advance_to(15)
+        taken = reactor.maybe_take_snapshot(sim.state, app=sim.app)
+        assert taken is not None and taken.height == 15
+
+    def test_retain_blocks_prunes_store_after_snapshot(self):
+        from tests.helpers import ChainSim
+
+        sim = ChainSim(n_vals=4)
+        store = BlockStore(MemDB())
+        for _ in range(12):
+            block = sim.advance()
+            store.save_block(block, block.make_part_set(), sim.commits[-1])
+        reactor = self._reactor(
+            SnapshotStore(MemDB(), hasher=HOST_HASHER, chunk_size=64),
+            store,
+            sim.state,
+            snapshot_interval=5,
+            retain_blocks=4,
+        )
+        assert reactor.maybe_take_snapshot(sim.state, app=sim.app) is not None
+        # pruned to head-retain+1: [9..12] kept, history below answers None
+        assert store.base == 9 and store.height == 12
+        assert store.load_block(8) is None
+        assert store.load_block(9) is not None
+
+    def test_retain_blocks_zero_keeps_everything(self):
+        from tests.helpers import ChainSim
+
+        sim = ChainSim(n_vals=4)
+        store = BlockStore(MemDB())
+        for _ in range(6):
+            block = sim.advance()
+            store.save_block(block, block.make_part_set(), sim.commits[-1])
+        reactor = self._reactor(
+            SnapshotStore(MemDB(), hasher=HOST_HASHER, chunk_size=64),
+            store,
+            sim.state,
+            snapshot_interval=5,
+            retain_blocks=0,
+        )
+        assert reactor.maybe_take_snapshot(sim.state, app=sim.app) is not None
+        assert store.base == 1 and store.load_block(1) is not None

@@ -138,6 +138,164 @@ def statesync_summary() -> dict | None:
     return out
 
 
+def _build_chain(n_blocks: int, n_vals: int):
+    """A real committed chain (blocks + quorum commits + genesis) via
+    the testing chain machinery — what the fast-sync bench replays."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.client import local_client_creator
+    from tendermint_tpu.db.kv import MemDB
+    from tendermint_tpu.state import apply_block, make_genesis_state
+    from tendermint_tpu.testing.nemesis import make_genesis
+    from tendermint_tpu.types import BlockID, Commit, Txs
+    from tendermint_tpu.types.block import Block
+    from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT
+    from tendermint_tpu.types.vote_set import VoteSet
+    from tendermint_tpu.types.vote import Vote
+
+    genesis, privs = make_genesis(n_vals, chain_id="bench-fastsync")
+    state = make_genesis_state(MemDB(), genesis)
+    state.save()
+    conns = local_client_creator(KVStoreApp())()
+    blocks, commits = [], []
+    for _ in range(n_blocks):
+        height = state.last_block_height + 1
+        last_commit = commits[-1] if commits else Commit.empty()
+        block = Block.make_block(
+            height=height,
+            chain_id=state.chain_id,
+            txs=Txs([]),
+            last_commit=last_commit,
+            last_block_id=state.last_block_id,
+            time=genesis.genesis_time + height * 1_000_000_000,
+            validators_hash=state.validators.hash(),
+            app_hash=state.app_hash,
+        )
+        part_set = block.make_part_set()
+        block_id = BlockID(block.hash(), part_set.header)
+        vote_set = VoteSet(
+            state.chain_id, height, 0, VOTE_TYPE_PRECOMMIT, state.validators
+        )
+        for i, priv in enumerate(privs):
+            vote = Vote(
+                validator_address=priv.address,
+                validator_index=i,
+                height=height,
+                round=0,
+                timestamp=genesis.genesis_time + height * 1_000_000_000,
+                type=VOTE_TYPE_PRECOMMIT,
+                block_id=block_id,
+            )
+            vote_set.add_vote(priv.sign_vote(state.chain_id, vote))
+        apply_block(state, block, part_set.header, conns.consensus)
+        blocks.append(block)
+        commits.append(vote_set.make_commit())
+    return genesis, blocks
+
+
+class _LaunchLatencyVerifier:
+    """CPU stand-in for the device verifier's dispatch shape: real host
+    crypto preceded by the measured fixed launch cost (~86 ms through
+    the axon tunnel, docs/PLATFORM_NOTES.md) spent OFF the GIL — which
+    is exactly what an in-flight kernel looks like to the host. Lets the
+    checked-in CPU seed measure what the pipeline hides; on a TPU
+    backend the bench uses the real table verifier instead."""
+
+    def __init__(self, launch_s: float):
+        from tendermint_tpu.services.verifier import HostBatchVerifier
+
+        self._host = HostBatchVerifier()
+        self._launch_s = launch_s
+
+    def verify_batch(self, triples):
+        time.sleep(self._launch_s)
+        return self._host.verify_batch(triples)
+
+    # async seam: whole call runs on the DispatchQueue worker
+    launch_verify_batch = verify_batch
+
+    def finalize_verify_batch(self, launched):
+        return launched
+
+    def verify_batch_async(self, triples, queue=None):
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(lambda: self.verify_batch(triples), kind="verify")
+
+
+def _overlap_stats():
+    """(count, sum) of the fastsync queue's overlap-ratio histogram."""
+    n, total, _, _ = _histo("tendermint_dispatch_overlap_ratio", queue="fastsync")
+    return n, total
+
+
+def drive_fastsync_pipeline(
+    n_blocks: int, n_vals: int, launch_ms: float, on_device: bool
+) -> dict:
+    """Replay one committed chain through the REAL
+    `BlockchainReactor._try_sync` twice — pipeline depth 1 (the
+    synchronous verify->apply baseline) vs the default overlapped depth
+    — and report blocks/s plus the telemetry-measured overlap ratio."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.client import local_client_creator
+    from tendermint_tpu.blockchain.reactor import PIPELINE_DEPTH, BlockchainReactor
+    from tendermint_tpu.blockchain.store import BlockStore
+    from tendermint_tpu.db.kv import MemDB
+    from tendermint_tpu.state import make_genesis_state
+
+    genesis, blocks = _build_chain(n_blocks, n_vals)
+    if on_device:
+        from tendermint_tpu.services.resilient import ResilientVerifier
+        from tendermint_tpu.services.verifier import TableBatchVerifier
+
+        verifier = ResilientVerifier(TableBatchVerifier(min_device_batch=1))
+        launch_ms = 0.0  # real launches, no emulation
+    else:
+        verifier = _LaunchLatencyVerifier(launch_ms / 1e3)
+
+    def run(depth: int) -> float:
+        state = make_genesis_state(MemDB(), genesis)
+        state.save()
+        store = BlockStore(MemDB())
+        conns = local_client_creator(KVStoreApp())()
+        reactor = BlockchainReactor(
+            state=state,
+            store=store,
+            app_conn=conns.consensus,
+            fast_sync=True,
+            verifier=verifier,
+            pipeline_depth=depth,
+        )
+        reactor.pool.set_peer_height("bench", len(blocks))
+        for h, b in enumerate(blocks, start=1):
+            reactor.pool._blocks[h] = (b, "bench")
+        t0 = time.perf_counter()
+        reactor._try_sync()
+        dt = time.perf_counter() - t0
+        assert store.height == len(blocks) - 1, (
+            f"bench sync stalled at {store.height}"
+        )
+        return (len(blocks) - 1) / dt
+
+    depth = max(2, PIPELINE_DEPTH)
+    sync_bps = run(1)
+    ov_n0, ov_s0 = _overlap_stats()
+    pipelined_bps = run(depth)
+    ov_n1, ov_s1 = _overlap_stats()
+    overlap = (ov_s1 - ov_s0) / (ov_n1 - ov_n0) if ov_n1 > ov_n0 else 0.0
+    return {
+        "blocks": n_blocks,
+        "validators": n_vals,
+        "pipeline_depth": depth,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": not on_device,
+        "sync_blocks_per_s": round(sync_bps, 1),
+        "pipelined_blocks_per_s": round(pipelined_bps, 1),
+        "speedup": round(pipelined_bps / sync_bps, 3),
+        "overlap_ratio_mean": round(overlap, 3),
+    }
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -221,6 +379,28 @@ def main(argv=None) -> int:
         help="snapshot payload size driven through take+verify (0 skips)",
     )
     ap.add_argument(
+        "--fastsync-blocks",
+        type=int,
+        default=96,
+        dest="fastsync_blocks",
+        help="chain length replayed through the fast-sync pipeline (0 skips)",
+    )
+    ap.add_argument(
+        "--fastsync-vals",
+        type=int,
+        default=8,
+        dest="fastsync_vals",
+        help="validators signing each bench commit",
+    )
+    ap.add_argument(
+        "--launch-ms",
+        type=float,
+        default=86.0,
+        dest="launch_ms",
+        help="emulated device launch cost on CPU (PLATFORM_NOTES axon "
+        "tunnel figure); ignored on a real device backend",
+    )
+    ap.add_argument(
         "--no-device",
         action="store_true",
         help="skip device backends even on TPU",
@@ -248,22 +428,35 @@ def main(argv=None) -> int:
         drive_verify_device(sizes, args.reps)
         drive_verify_tables(n_vals=max(sizes), stack=8, reps=args.reps)
         drive_hash(sizes, args.reps, "device")
+    # snapshot the backend summaries BEFORE the fast-sync replay: its
+    # chain build + window verifies would otherwise pollute the
+    # per-backend verifies/s with small consensus-shaped batches
+    verify_summaries = {
+        b: s
+        for b in ("host", "device", "tables")
+        if (s := backend_summary(b)) is not None
+    }
+    hash_summaries = {
+        b: s for b in ("host", "device") if (s := hash_summary(b)) is not None
+    }
+    fastsync_pipeline = None
+    if args.fastsync_blocks > 0:
+        sys.stderr.write(
+            f"driving fast-sync pipeline {args.fastsync_blocks} blocks x "
+            f"{args.fastsync_vals} vals (sync vs overlapped)...\n"
+        )
+        fastsync_pipeline = drive_fastsync_pipeline(
+            args.fastsync_blocks, args.fastsync_vals, args.launch_ms, on_device
+        )
 
     wal_count, wal_sum, wal_p50, wal_p99 = _histo("tendermint_wal_fsync_seconds")
     detail = {
         "wall_s": round(time.time() - t0, 2),
         "backend": jax.default_backend(),
-        "verify": {
-            b: s
-            for b in ("host", "device", "tables")
-            if (s := backend_summary(b)) is not None
-        },
-        "hash": {
-            b: s
-            for b in ("host", "device")
-            if (s := hash_summary(b)) is not None
-        },
+        "verify": verify_summaries,
+        "hash": hash_summaries,
         "statesync": statesync_summary(),
+        "fastsync_pipeline": fastsync_pipeline,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
