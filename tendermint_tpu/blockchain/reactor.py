@@ -49,8 +49,30 @@ VERIFY_WINDOW = 16  # commits batched per device call
 # 2 is the classic software pipeline: window K's verdict flies while the
 # host preps K+1's part sets/lanes and applies K-1's blocks via ABCI.
 # 1 degenerates to the synchronous verify->apply loop (the bench
-# baseline); >2 only helps when apply is slower than a device launch.
+# baseline); >2 only helps when launches are slower than applies.
 PIPELINE_DEPTH = int(os.environ.get("TENDERMINT_TPU_PIPELINE_DEPTH", "2"))
+
+
+def adaptive_pipeline_depth() -> int:
+    """Default pipeline depth from the measured launch:apply ratio.
+
+    The env knob always wins. Without it, the dispatch telemetry's
+    overlap histogram (what the fastsync queue already exports) gives
+    launch:apply ≈ (1-o)/o; `1 + round(ratio)` windows keep the device
+    busy while one window applies — balanced pipelines land on the
+    classic depth 2, launch-dominated ones deepen, apply-dominated ones
+    collapse to the synchronous loop. Clamped to [1, 4]: beyond 4 the
+    extra in-flight windows only add redo-drain latency.
+    """
+    env = os.environ.get("TENDERMINT_TPU_PIPELINE_DEPTH")
+    if env:
+        return max(1, int(env))
+    from tendermint_tpu.services.dispatch import measured_launch_apply_ratio
+
+    ratio = measured_launch_apply_ratio("fastsync")
+    if ratio is None:
+        return 2
+    return max(1, min(4, 1 + int(round(ratio))))
 
 
 def _enc(tag: int, *fields) -> bytes:
@@ -115,7 +137,8 @@ class BlockchainReactor(Reactor):
         self.deferred = deferred
         self.pool = BlockPool(start_height=store.height + 1)
         self.pipeline_depth = max(
-            1, PIPELINE_DEPTH if pipeline_depth is None else pipeline_depth
+            1,
+            adaptive_pipeline_depth() if pipeline_depth is None else pipeline_depth,
         )
         self._dispatch_queue = None  # lazy: only fast-syncing nodes need it
         self._running = False
@@ -348,6 +371,7 @@ class BlockchainReactor(Reactor):
                 entries,
                 verifier=self.verifier,
                 queue=self._queue(),
+                consumer="fastsync",
             )
         except ValidationError:
             # malformed commit caught during prep — same treatment as a
@@ -445,6 +469,7 @@ class BlockchainReactor(Reactor):
                 block.header.height,
                 commit,
                 verifier=self.verifier,
+                consumer="fastsync",
             )
         except ValidationError:
             self._redo(block.header.height)

@@ -78,12 +78,26 @@ class FullCommit:
 class StaticCertifier:
     """Certify against one fixed validator set (reference
     `static.go:49-65`). Raises ErrValidatorsChanged when the header
-    names a different set — the dynamic/inquiring layers react to that."""
+    names a different set — the dynamic/inquiring layers react to that.
 
-    def __init__(self, chain_id: str, validators: ValidatorSet, verifier=None):
+    `consumer` tags this walk's verify requests for the coalescer
+    (`services/batcher.py`): light-client walks default to "rpc", the
+    statesync trust anchor re-tags its certifiers "statesync" — so a
+    certifier re-walk over overlapping valsets both hits the dedup
+    cache and merges its novel signatures into whatever launch the
+    consensus/fast-sync pipelines have in flight."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        validators: ValidatorSet,
+        verifier=None,
+        consumer: str = "rpc",
+    ):
         self.chain_id = chain_id
         self.validators = validators
         self.verifier = verifier
+        self.consumer = consumer
 
     def certify(self, fc: FullCommit) -> None:
         self.certify_batch([fc])
@@ -102,7 +116,7 @@ class StaticCertifier:
                 )
             entries.append((fc.commit.block_id, fc.height(), fc.commit))
         self.validators.verify_commit_batched(
-            self.chain_id, entries, verifier=self.verifier
+            self.chain_id, entries, verifier=self.verifier, consumer=self.consumer
         )
 
 
@@ -112,9 +126,14 @@ class DynamicCertifier:
     it (reference `dynamic.go:49-93`)."""
 
     def __init__(
-        self, chain_id: str, validators: ValidatorSet, height: int = 0, verifier=None
+        self,
+        chain_id: str,
+        validators: ValidatorSet,
+        height: int = 0,
+        verifier=None,
+        consumer: str = "rpc",
     ):
-        self.cert = StaticCertifier(chain_id, validators, verifier)
+        self.cert = StaticCertifier(chain_id, validators, verifier, consumer=consumer)
         self.last_height = height
 
     @property
@@ -144,9 +163,11 @@ class DynamicCertifier:
             fc.height(),
             fc.commit,
             verifier=self.cert.verifier,
+            consumer=self.cert.consumer,
         )
         self.cert = StaticCertifier(
-            self.chain_id, fc.validators, self.cert.verifier
+            self.chain_id, fc.validators, self.cert.verifier,
+            consumer=self.cert.consumer,
         )
         self.last_height = fc.height()
 
@@ -161,14 +182,23 @@ class InquiringCertifier:
     from a full node) which become trusted only after `update` succeeds.
     """
 
-    def __init__(self, chain_id: str, seed: FullCommit, trusted, source, verifier=None):
+    def __init__(
+        self,
+        chain_id: str,
+        seed: FullCommit,
+        trusted,
+        source,
+        verifier=None,
+        consumer: str = "rpc",
+    ):
         self.chain_id = chain_id
         self.trusted = trusted
         self.source = source
         self.verifier = verifier
+        self.consumer = consumer
         trusted.store_commit(seed)
         self.cert = DynamicCertifier(
-            chain_id, seed.validators, seed.height(), verifier
+            chain_id, seed.validators, seed.height(), verifier, consumer=consumer
         )
 
     @property
@@ -193,7 +223,11 @@ class InquiringCertifier:
         tfc = self.trusted.get_by_height(height)
         if tfc is not None and tfc.height() > self.cert.last_height:
             self.cert = DynamicCertifier(
-                self.chain_id, tfc.validators, tfc.height(), self.verifier
+                self.chain_id,
+                tfc.validators,
+                tfc.height(),
+                self.verifier,
+                consumer=self.consumer,
             )
         sfc = self.source.get_by_height(height)
         if sfc is None:

@@ -440,8 +440,12 @@ class ConsensusState:
             return out
 
         if hasattr(verifier, "verify_batch_async"):
+            from tendermint_tpu.services.batcher import consumer_kwargs
+
             return verifier.verify_batch_async(
-                triples, queue=self._vote_queue()
+                triples,
+                queue=self._vote_queue(),
+                **consumer_kwargs(verifier, "consensus"),
             ).then(_scatter)
         return CompletedHandle(_scatter(verifier.verify_batch(triples)))
 

@@ -91,6 +91,16 @@ class Node:
         )
         self.node_id = self.node_key.node_id
 
+        # span-timeline persistence: replay the pre-restart window into
+        # the tracer (post-mortems survive the process), then sink every
+        # new span to the bounded JSONL ring under the data dir
+        from tendermint_tpu.telemetry import TRACER
+        from tendermint_tpu.telemetry.spanlog import persist_spans
+
+        self._span_log = persist_spans(
+            TRACER, os.path.join(cfg.home, cfg.base.db_dir, "spans.jsonl")
+        )
+
         # state + stores
         self.state_db = _db("state")
         st = load_state(self.state_db)
@@ -524,6 +534,11 @@ class Node:
         self.switch.stop()
         self.mempool.close()
         self.app_conns.close()
+        if getattr(self, "_span_log", None) is not None:
+            from tendermint_tpu.telemetry import TRACER
+
+            TRACER.clear_sink(self._span_log.append)
+            self._span_log.close()
 
     # -- convenience -------------------------------------------------------
 

@@ -303,6 +303,37 @@ class DispatchQueue:
             self._work.put(_STOP)
 
 
+def measured_launch_apply_ratio(queue: str | None = None) -> float | None:
+    """launch:apply ratio inferred from the overlap histogram this
+    module already exports: a handle's overlap `o` is the share of its
+    life the consumer spent on OTHER work (host prep + ABCI applies), so
+    blocked:overlapped = (1-o):o estimates device-launch time vs host
+    apply time. None until any handle has been joined.
+
+    Consumers of the estimate: the fast-sync pipeline sizes its depth
+    (≈ 1 + ratio windows keeps the device busy while one applies) and
+    the verify coalescer scales its flush window (launch-dominated
+    pipelines amortize more per merged launch). `queue` narrows to one
+    pipeline's series; None aggregates all of them.
+    """
+    from tendermint_tpu.telemetry import REGISTRY
+
+    fam = REGISTRY.get("tendermint_dispatch_overlap_ratio")
+    if fam is None:
+        return None
+    total = 0.0
+    count = 0
+    for values, snap in fam.samples():
+        if queue is not None and values != (queue,):
+            continue
+        total += snap["sum"]
+        count += snap["count"]
+    if count == 0:
+        return None
+    o = min(max(total / count, 0.01), 0.99)
+    return (1.0 - o) / o
+
+
 _DEFAULT_QUEUE: DispatchQueue | None = None
 _DEFAULT_LOCK = threading.Lock()
 

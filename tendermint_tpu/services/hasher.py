@@ -115,6 +115,16 @@ class TreeHasher:
         _observe_hash("host", len(items), time.perf_counter() - t0)
         return out
 
+    def leaf_hashes_async(self, items: list[bytes], queue=None):
+        """`leaf_hashes` through a `DispatchQueue` handle — the chunk-
+        verify gate submits the whole-set hash and overlaps payload
+        decode while it runs (statesync/snapshot.py). The resilient
+        wrapper overrides this with the breaker-guarded version."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(lambda: self.leaf_hashes(items), kind="hash")
+
     def proofs(self, items: list[bytes]):
         """Merkle proofs stay on host: O(N log N) pointer work, tiny data."""
         return host_merkle.simple_proofs_from_byte_slices(items, self.algo)

@@ -289,7 +289,9 @@ class ResilientVerifier(BatchVerifier):
             self.primary.verify_batch, self.fallback.verify_batch, triples
         )
 
-    def verify_batch_async(self, triples: Sequence[Triple], queue=None):
+    def verify_batch_async(
+        self, triples: Sequence[Triple], queue=None, consumer: str = "default"
+    ):
         """Breaker-guarded async verify: the handle always resolves to
         a verdict mask — a faulted in-flight launch re-verifies on host
         at the join instead of raising into the pipeline."""
@@ -303,7 +305,9 @@ class ResilientVerifier(BatchVerifier):
             lambda: self.fallback.verify_batch(triples),
         )
 
-    def verify_commits_async(self, pubkeys, commits, queue=None, force_fused=None):
+    def verify_commits_async(
+        self, pubkeys, commits, queue=None, force_fused=None, consumer="default"
+    ):
         """Async commit-grid verify with the same guarantee: device
         faults at launch OR materialization degrade to the host commit
         loop inside the handle."""
@@ -430,6 +434,20 @@ class ResilientTreeHasher(TreeHasher):
     def leaf_hashes(self, items: list[bytes]) -> list[bytes]:
         return self._dispatch.call(
             self.primary.leaf_hashes, self.fallback.leaf_hashes, items
+        )
+
+    def leaf_hashes_async(self, items: list[bytes], queue=None):
+        """Breaker-guarded async leaf hashing (the statesync chunk-
+        verify gate): the handle resolves to the per-item hashes, with
+        device faults degrading to host hashlib inside the handle."""
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return self._dispatch.call_async(
+            q,
+            lambda: self.primary.leaf_hashes(items),
+            lambda hashes: hashes,
+            lambda: self.fallback.leaf_hashes(items),
         )
 
     def proofs(self, items: list[bytes]):

@@ -42,6 +42,13 @@ def _observe_verify(backend: str, n: int, seconds: float) -> None:
 class BatchVerifier:
     """Interface + shared accumulate/flush bookkeeping."""
 
+    # Every in-tree verifier tolerates the `consumer=` tag on its async
+    # surface (the coalescer uses it for fairness + wait telemetry;
+    # plain backends ignore it). Call sites gate on this attribute so
+    # minimal test fakes without the kwarg keep working
+    # (`services/batcher.py::consumer_kwargs`).
+    accepts_consumer = True
+
     def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
         raise NotImplementedError
 
@@ -81,11 +88,14 @@ class BatchVerifier:
     def finalize_verify_batch(self, launched) -> np.ndarray:
         return launched
 
-    def verify_batch_async(self, triples: Sequence[Triple], queue=None):
+    def verify_batch_async(
+        self, triples: Sequence[Triple], queue=None, consumer: str = "default"
+    ):
         """Submit a batch verify through a `DispatchQueue`; returns a
         `VerifyHandle` whose `.result()` yields the same per-item
         verdict mask `verify_batch` would. Device arrays stay
-        un-materialized until the join."""
+        un-materialized until the join. `consumer` only matters to the
+        coalescing wrapper; plain backends ignore it."""
         from tendermint_tpu.services.dispatch import default_dispatch_queue
 
         q = queue if queue is not None else default_dispatch_queue()
@@ -484,7 +494,12 @@ class TableBatchVerifier(DeviceBatchVerifier):
         return np.concatenate(out_rows, axis=0)
 
     def verify_commits_async(
-        self, pubkeys, commits, queue=None, force_fused: bool | None = None
+        self,
+        pubkeys,
+        commits,
+        queue=None,
+        force_fused: bool | None = None,
+        consumer: str = "default",
     ):
         """`verify_commits` through the dispatch queue: a VerifyHandle
         resolving to the (K, N) verdict grid, kernels in flight until
@@ -535,6 +550,12 @@ def default_verifier() -> BatchVerifier:
     (`services/resilient.py`); host-only runs get the wrapper too when
     fault injection / TENDERMINT_TPU_RESILIENT is armed, so chaos tests
     exercise the same dispatch path CI-side.
+
+    Whatever the backend stack, the outermost layer is the
+    `CoalescingVerifier` (`services/batcher.py`): a verified-signature
+    dedup cache plus the cross-consumer launch coalescer. Disable with
+    TENDERMINT_TPU_COALESCE=0 (the wrap is verdict-transparent — only
+    positives are cached, failures always re-verify).
     """
     global _DEFAULT
     if _DEFAULT is None:
@@ -546,13 +567,19 @@ def default_verifier() -> BatchVerifier:
             if device_faults_armed():
                 from tendermint_tpu.services.resilient import ResilientVerifier
 
-                _DEFAULT = ResilientVerifier(DeviceBatchVerifier())
+                inner: BatchVerifier = ResilientVerifier(DeviceBatchVerifier())
             else:
-                _DEFAULT = HostBatchVerifier()
+                inner = HostBatchVerifier()
         else:
             from tendermint_tpu.services.resilient import ResilientVerifier
 
-            _DEFAULT = ResilientVerifier(TableBatchVerifier())
+            inner = ResilientVerifier(TableBatchVerifier())
+        if os.environ.get("TENDERMINT_TPU_COALESCE", "1") != "0":
+            from tendermint_tpu.services.batcher import CoalescingVerifier
+
+            _DEFAULT = CoalescingVerifier(inner)
+        else:
+            _DEFAULT = inner
     return _DEFAULT
 
 

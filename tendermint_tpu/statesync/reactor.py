@@ -39,7 +39,7 @@ from tendermint_tpu.statesync.snapshot import (
     SnapshotManifest,
     SnapshotStore,
     decode_payload,
-    verify_chunks,
+    verify_chunks_async,
 )
 from tendermint_tpu.statesync.trust import TrustAnchor
 from tendermint_tpu.telemetry import metrics as _metrics
@@ -689,11 +689,26 @@ class StateSyncReactor(Reactor):
                 time.sleep(_SYNC_TICK_S)
             if not pool.is_complete():
                 return None
-            # 3. whole-set verification in one device batch, then restore
+            # 3. whole-set verification in one device batch — launched
+            # as a dispatch handle so the payload decode (pure parsing,
+            # applies nothing) overlaps the in-flight hash kernel; the
+            # gate is joined BEFORE any restore side effect
             chunks = pool.chunks()
             try:
-                verify_chunks(manifest, chunks, self.hasher)
-                state = self._restore(manifest, b"".join(chunks), anchor_fc)
+                gate = verify_chunks_async(manifest, chunks, self.hasher)
+                payload = b"".join(chunks)[: manifest.payload_len]
+                try:
+                    decoded = decode_payload(payload)
+                except Exception as decode_err:
+                    try:
+                        gate.result()  # release the handle's queue slot
+                    except ValidationError:
+                        pass
+                    raise ValidationError(
+                        f"snapshot payload undecodable: {decode_err}"
+                    ) from decode_err
+                gate.result()
+                state = self._restore(manifest, decoded, anchor_fc)
             except ValidationError as e:
                 self._reject(key, f"restore failed: {e}")
                 _metrics.STATESYNC_RESTORES.labels(result="failed").inc()
@@ -705,13 +720,14 @@ class StateSyncReactor(Reactor):
         finally:
             self._pool, self._active_key = None, None
 
-    def _restore(self, manifest: SnapshotManifest, payload: bytes, anchor_fc):
-        """Apply a fully-verified chunk payload: state DB, app state,
-        block-store tail. Only reached after the batched Merkle pass."""
+    def _restore(self, manifest: SnapshotManifest, decoded, anchor_fc):
+        """Apply a fully-decoded, fully-VERIFIED chunk payload: state
+        DB, app state, block-store tail. Only reached after the batched
+        Merkle gate joined clean (decoding itself happens earlier,
+        overlapped with the in-flight hash launch)."""
         from tendermint_tpu.state.state import State
 
-        payload = payload[: manifest.payload_len]
-        state_json, app_state, tail = decode_payload(payload)
+        state_json, app_state, tail = decoded
         state = State.from_json(state_json, db=self.state_db)
         if state.last_block_height != manifest.height:
             raise ValidationError(

@@ -175,29 +175,56 @@ def split_chunks(payload: bytes, chunk_size: int) -> list[bytes]:
     return [payload[i : i + chunk_size] for i in range(0, len(payload), chunk_size)]
 
 
-def verify_chunks(
-    manifest: SnapshotManifest, chunks: list[bytes], hasher=None
-) -> None:
-    """Full-batch verification of an assembled chunk set against the
-    manifest root: leaf-hash every chunk and fold the tree in one device
-    batch (host hashlib behind the breaker otherwise). Raises
-    ValidationError naming the first bad chunk index."""
+def verify_chunks_async(
+    manifest: SnapshotManifest, chunks: list[bytes], hasher=None, queue=None
+):
+    """The chunk-verify gate as a dispatch HANDLE: the whole-set leaf
+    hashing launches through the hasher's async seam (device batch on
+    TPU, breaker-guarded), the per-chunk comparison + root fold run at
+    the join. The restore path overlaps payload decoding with the
+    in-flight hash launch and joins this gate before applying anything.
+    The handle's `.result()` raises the same ValidationErrors the
+    blocking `verify_chunks` did."""
+    from tendermint_tpu.services.dispatch import CompletedHandle
+
     if len(chunks) != manifest.chunks:
-        raise ValidationError(
-            f"have {len(chunks)} chunks, manifest wants {manifest.chunks}"
+        return CompletedHandle(
+            exc=ValidationError(
+                f"have {len(chunks)} chunks, manifest wants {manifest.chunks}"
+            )
         )
     t0 = time.perf_counter()
-    hashes = _chunk_leaf_hashes(chunks, hasher)
-    for i, (got, want) in enumerate(zip(hashes, manifest.chunk_hashes)):
-        if got != want:
+
+    def _gate(hashes) -> bool:
+        try:
+            for i, (got, want) in enumerate(zip(hashes, manifest.chunk_hashes)):
+                if got != want:
+                    raise ValidationError(f"chunk {i} hash mismatch")
+            if _root_from_leaf_hashes(hashes, hasher) != manifest.root:
+                raise ValidationError("chunk tree does not fold to manifest root")
+            return True
+        finally:
             _metrics.STATESYNC_CHUNK_VERIFY_SECONDS.observe(
                 time.perf_counter() - t0
             )
-            raise ValidationError(f"chunk {i} hash mismatch")
-    if _root_from_leaf_hashes(hashes, hasher) != manifest.root:
-        _metrics.STATESYNC_CHUNK_VERIFY_SECONDS.observe(time.perf_counter() - t0)
-        raise ValidationError("chunk tree does not fold to manifest root")
-    _metrics.STATESYNC_CHUNK_VERIFY_SECONDS.observe(time.perf_counter() - t0)
+
+    if hasher is not None and hasattr(hasher, "leaf_hashes_async"):
+        return hasher.leaf_hashes_async(chunks, queue=queue).then(_gate)
+    hashes = _chunk_leaf_hashes(chunks, hasher)
+    try:
+        return CompletedHandle(_gate(hashes))
+    except ValidationError as e:
+        return CompletedHandle(exc=e)
+
+
+def verify_chunks(
+    manifest: SnapshotManifest, chunks: list[bytes], hasher=None
+) -> None:
+    """Blocking chunk-set verification (submit + join of the async
+    gate): leaf-hash every chunk and fold the tree in one device batch
+    (host hashlib behind the breaker otherwise). Raises ValidationError
+    naming the first bad chunk index."""
+    verify_chunks_async(manifest, chunks, hasher).result()
 
 
 # -- store --------------------------------------------------------------------

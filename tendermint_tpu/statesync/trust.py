@@ -121,11 +121,19 @@ class TrustAnchor:
                 raise ValidationError("trust root configured but no pin commit fetched")
             self.verify_pin(pin_fc)
             cert = DynamicCertifier(
-                self.chain_id, pin_fc.validators, opts.height, self.verifier
+                self.chain_id,
+                pin_fc.validators,
+                opts.height,
+                self.verifier,
+                consumer="statesync",
             )
         else:
             cert = DynamicCertifier(
-                self.chain_id, self.base_validators, 0, self.verifier
+                self.chain_id,
+                self.base_validators,
+                0,
+                self.verifier,
+                consumer="statesync",
             )
         if opts.trust_period_ns > 0:
             age = self._now_ns() - anchor_fc.header.time

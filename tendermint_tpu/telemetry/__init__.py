@@ -30,8 +30,20 @@ __all__ = [
     "Registry",
     "REGISTRY",
     "Span",
+    "SpanLog",
     "Tracer",
     "TRACER",
     "LATENCY_BUCKETS",
     "SIZE_BUCKETS",
+    "persist_spans",
 ]
+
+
+def __getattr__(name: str):
+    # spanlog lazily: it is the one module here that touches the
+    # filesystem, and most importers only want the registry/tracer
+    if name in ("SpanLog", "persist_spans"):
+        from tendermint_tpu.telemetry import spanlog
+
+        return getattr(spanlog, name)
+    raise AttributeError(name)

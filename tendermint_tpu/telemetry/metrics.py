@@ -152,13 +152,50 @@ DISPATCH_OVERLAP = Histogram(
     buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0),
 )
 
-# Pre-seed the known breaker kinds and round-skip phases so scrapes see
-# zero-valued series before (or without) any instance/event — Prometheus
-# convention: known label values start at 0, absence means "unknown".
+# -- verify coalescer + dedup cache (services/batcher.py) ---------------------
+#
+# `consumer` labels are the verify-request owners ("consensus",
+# "fastsync", "statesync", "rpc", "default") — a fixed small set.
+
+VERIFY_CACHE_HITS = Counter(
+    "tendermint_verify_cache_hits_total",
+    "Signature triples answered from the verified-signature dedup cache",
+)
+VERIFY_CACHE_MISSES = Counter(
+    "tendermint_verify_cache_misses_total",
+    "Signature triples not in the dedup cache (dispatched for verification)",
+)
+VERIFY_CACHE_EVICTIONS = Counter(
+    "tendermint_verify_cache_evictions_total",
+    "Proven triples evicted from the dedup cache by LRU pressure",
+)
+BATCHER_COALESCE = Histogram(
+    "tendermint_batcher_coalesce_factor",
+    "Verify requests merged into one coalesced device launch",
+    buckets=SIZE_BUCKETS,
+)
+BATCHER_FLUSH = Counter(
+    "tendermint_batcher_flush_total",
+    "Coalescer flushes by trigger (window/size/barrier)",
+    labelnames=("reason",),
+)
+BATCHER_WAIT = Histogram(
+    "tendermint_batcher_wait_seconds",
+    "Time a verify request waited in the coalescer before its launch",
+    labelnames=("consumer",),
+    buckets=LATENCY_BUCKETS,
+)
+
+# Pre-seed the known breaker kinds, round-skip phases, and flush reasons
+# so scrapes see zero-valued series before (or without) any
+# instance/event — Prometheus convention: known label values start at 0,
+# absence means "unknown".
 for _kind in ("verify", "hash", "tables"):
     BREAKER_STATE.labels(kind=_kind).set(0)
 for _phase in ("prevote", "precommit"):
     CONSENSUS_ROUND_SKIPS.labels(phase=_phase).inc(0)
+for _reason in ("window", "size", "barrier"):
+    BATCHER_FLUSH.labels(reason=_reason).inc(0)
 
 # -- state sync ---------------------------------------------------------------
 

@@ -40,16 +40,36 @@ class Span:
 
 
 class Tracer:
-    """Bounded ring of completed spans; thread-safe."""
+    """Bounded ring of completed spans; thread-safe. An optional sink
+    callback observes every completed span (the JSONL span log persists
+    them across restarts — `telemetry/spanlog.py`); sink errors are
+    swallowed, recording must never fail the traced path."""
 
     def __init__(self, capacity: int = 1024) -> None:
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=capacity)
+        self._sink = None
+
+    def set_sink(self, fn) -> None:
+        """Install `fn(span)` as the completion sink (None clears)."""
+        self._sink = fn
+
+    def clear_sink(self, fn) -> None:
+        """Remove the sink only if `fn` is still the installed one —
+        a stopping node must not strip a successor's sink."""
+        if self._sink is fn:
+            self._sink = None
 
     def add(self, name: str, start: float, end: float, **attrs) -> Span:
         span = Span(name, start, end, attrs)
         with self._lock:
             self._spans.append(span)
+        sink = self._sink
+        if sink is not None:
+            try:
+                sink(span)
+            except Exception:
+                pass
         return span
 
     @contextmanager

@@ -197,7 +197,13 @@ class ValidatorSet:
         return triples, indices
 
     def verify_commit(
-        self, chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+        self,
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit,
+        verifier=None,
+        consumer: str = "default",
     ) -> None:
         """Raise unless >2/3 of this set's power signed block_id at height.
 
@@ -207,7 +213,7 @@ class ValidatorSet:
         `verify_commit_batched`.
         """
         self.verify_commit_batched(
-            chain_id, [(block_id, height, commit)], verifier
+            chain_id, [(block_id, height, commit)], verifier, consumer=consumer
         )
 
     def verify_commit_batched(
@@ -215,6 +221,7 @@ class ValidatorSet:
         chain_id: str,
         entries: list[tuple[BlockID, int, "object"]],
         verifier=None,
+        consumer: str = "default",
     ) -> None:
         """Verify K commits signed by THIS validator set as one device
         batch — the fast-sync window shape (BASELINE config 3; reference
@@ -225,11 +232,21 @@ class ValidatorSet:
         (the valset-table cache) get commits in validator-lane order so
         repeated commits of one valset hit cached per-validator comb
         tables; other verifiers get flat triple batches.
+
+        Verifiers advertising the consumer-tag surface (the coalescing
+        stack) are routed through the ASYNC handles and joined here —
+        that is how blocking callers (the certifier walk, statesync
+        trust anchoring) coalesce with concurrent consumers for free.
         """
         if verifier is None:
             from tendermint_tpu.services.verifier import default_verifier
 
             verifier = default_verifier()
+        if getattr(verifier, "accepts_consumer", False):
+            self.verify_commit_batched_async(
+                chain_id, entries, verifier, consumer=consumer
+            ).result()
+            return
         collected = [
             self._collect_commit_sigs(chain_id, bid, h, c)
             for bid, h, c in entries
@@ -255,6 +272,7 @@ class ValidatorSet:
         entries: list[tuple[BlockID, int, "object"]],
         verifier=None,
         queue=None,
+        consumer: str = "default",
     ):
         """Pipelined `verify_commit_batched`: lane prep + device submit
         happen NOW (the caller's host-prep stage), the quorum tally —
@@ -276,6 +294,9 @@ class ValidatorSet:
         ]
         n = len(self.validators)
 
+        from tendermint_tpu.services.batcher import consumer_kwargs
+
+        kw = consumer_kwargs(verifier, consumer)
         if hasattr(verifier, "verify_commits_async") and any(
             triples for triples, _ in collected
         ):
@@ -283,6 +304,7 @@ class ValidatorSet:
                 [v.pub_key.data for v in self.validators],
                 self._commit_lanes(collected, n),
                 queue=queue,
+                **kw,
             )
 
             def _tally_grid(grid):
@@ -294,7 +316,7 @@ class ValidatorSet:
             return handle.then(_tally_grid)
         if hasattr(verifier, "verify_batch_async"):
             flat = [t for triples, _ in collected for t in triples]
-            handle = verifier.verify_batch_async(flat, queue=queue)
+            handle = verifier.verify_batch_async(flat, queue=queue, **kw)
 
             def _tally_flat(mask):
                 ok_by_entry, at = [], 0
@@ -360,7 +382,14 @@ class ValidatorSet:
                 )
 
     def verify_commit_any(
-        self, new_set: "ValidatorSet", chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+        self,
+        new_set: "ValidatorSet",
+        chain_id: str,
+        block_id: BlockID,
+        height: int,
+        commit,
+        verifier=None,
+        consumer: str = "default",
     ) -> None:
         """Light-client rule (reference `VerifyCommitAny
         types/validator_set.go:284-349`): enough of the OLD set (this one,
@@ -396,7 +425,7 @@ class ValidatorSet:
             )
             old_powers.append(old_val.voting_power)
             new_powers.append(new_val.voting_power)
-        ok_mask = _verify_triples(triples, verifier)
+        ok_mask = _verify_triples(triples, verifier, consumer=consumer)
         old_tallied = 0
         new_tallied = 0
         for ok, op, np_ in zip(ok_mask, old_powers, new_powers):
@@ -424,14 +453,25 @@ class ValidatorSet:
         return f"ValidatorSet(n={len(self.validators)}, power={self._total})"
 
 
-def _verify_triples(triples: list[tuple[bytes, bytes, bytes]], verifier) -> list[bool]:
+def _verify_triples(
+    triples: list[tuple[bytes, bytes, bytes]], verifier, consumer: str = "default"
+) -> list[bool]:
     """Verify (pubkey,msg,sig) triples as one batch through the given
     BatchVerifier, defaulting to the process-wide verifier (device-backed
-    when an accelerator is present)."""
+    when an accelerator is present). Tagged verifiers (the coalescing
+    stack) route through an async handle joined here, so blocking
+    callers — `verify_commit_any` in the certifier walk — still merge
+    into coalesced launches."""
     if not triples:
         return []
     if verifier is None:
         from tendermint_tpu.services.verifier import default_verifier
 
         verifier = default_verifier()
+    if getattr(verifier, "accepts_consumer", False) and hasattr(
+        verifier, "verify_batch_async"
+    ):
+        return list(
+            verifier.verify_batch_async(triples, consumer=consumer).result()
+        )
     return list(verifier.verify_batch(triples))

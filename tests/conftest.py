@@ -37,6 +37,41 @@ def lint_kernel_marks(items) -> list[str]:
     ]
 
 
+def lint_metric_catalog(roots=None) -> list[str]:
+    """Catalog lint: every `tendermint_*` metric name used as a string
+    literal in the package (and tools/) must be registered by
+    `telemetry/metrics.py` — an unregistered name means a dashboard or
+    invariant is querying a series that will never exist. Returns
+    `path:name` offenders. Histogram exposition suffixes
+    (`_bucket`/`_sum`/`_count`) resolve to their base family."""
+    import pathlib
+    import re
+
+    import tendermint_tpu.telemetry.metrics  # noqa: F401 — fills the registry
+    from tendermint_tpu.telemetry import REGISTRY
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    if roots is None:
+        roots = [repo / "tendermint_tpu", repo / "tools"]
+    registered = {m.name for m in REGISTRY.metrics()}
+    pat = re.compile(r"""["'](tendermint_[a-z0-9_]+)["']""")
+    offenders: list[str] = []
+    for root in roots:
+        for path in sorted(pathlib.Path(root).rglob("*.py")):
+            for name in pat.findall(path.read_text(encoding="utf-8")):
+                if name.startswith("tendermint_tpu"):
+                    continue  # the package name, not a metric
+                base = re.sub(r"_(bucket|sum|count)$", "", name)
+                if name in registered or base in registered:
+                    continue
+                try:
+                    shown = path.relative_to(repo)
+                except ValueError:  # lint tests point at tmp dirs
+                    shown = path
+                offenders.append(f"{shown}:{name}")
+    return offenders
+
+
 def pytest_collection_modifyitems(config, items):
     bad = lint_kernel_marks(items)
     if bad:
@@ -44,4 +79,10 @@ def pytest_collection_modifyitems(config, items):
             "kernel-marked tests missing the slow mark (tier-1 `-m 'not "
             "slow'` would compile their XLA:CPU kernels): "
             + ", ".join(sorted(bad)[:10])
+        )
+    bad_metrics = lint_metric_catalog()
+    if bad_metrics:
+        raise pytest.UsageError(
+            "tendermint_* metric names used in code but missing from "
+            "telemetry/metrics.py's catalog: " + ", ".join(bad_metrics[:10])
         )

@@ -1,11 +1,13 @@
-"""The collection-time marker lint (tests/conftest.py): a `kernel` mark
+"""The collection-time lints (tests/conftest.py): a `kernel` mark
 without a `slow` mark would let tier-1's `-m 'not slow'` selection pull
-~20-minute XLA:CPU kernel compiles into the fast lane — the lint fails
-collection before that can land (ROADMAP tier-1 note)."""
+~20-minute XLA:CPU kernel compiles into the fast lane, and a
+`tendermint_*` metric name used in code but absent from the
+`telemetry/metrics.py` catalog means an invariant/dashboard queries a
+series that will never exist — both fail collection before landing."""
 
 import pytest
 
-from tests.conftest import lint_kernel_marks
+from tests.conftest import lint_kernel_marks, lint_metric_catalog
 
 
 class _FakeItem:
@@ -37,3 +39,25 @@ def test_collection_hook_raises_usage_error():
     bad = [_FakeItem("tests/test_a.py::test_compiles", {"kernel"})]
     with pytest.raises(pytest.UsageError, match="missing the slow mark"):
         conftest.pytest_collection_modifyitems(None, bad)
+
+
+class TestMetricCatalogLint:
+    def test_current_tree_is_clean(self):
+        assert lint_metric_catalog() == []
+
+    def test_unregistered_name_is_flagged(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            'NAME = "tendermint_not_in_the_catalog_total"\n'
+        )
+        off = lint_metric_catalog(roots=[tmp_path])
+        assert len(off) == 1
+        assert off[0].endswith(":tendermint_not_in_the_catalog_total")
+
+    def test_registered_names_and_suffixes_pass(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            'A = "tendermint_verify_seconds"\n'
+            'B = "tendermint_verify_seconds_count"\n'  # exposition suffix
+            'C = "tendermint_batcher_coalesce_factor"\n'
+            'PKG = "tendermint_tpu.services"\n'  # package path, not a metric
+        )
+        assert lint_metric_catalog(roots=[tmp_path]) == []

@@ -276,16 +276,22 @@ class TestFaultSpecParsing:
         from tendermint_tpu.services import hasher as hasher_mod
         from tendermint_tpu.services import verifier as verifier_mod
 
+        from tendermint_tpu.services.batcher import CoalescingVerifier
+
         fail.set_device_fault("verify")
         monkeypatch.setattr(verifier_mod, "_DEFAULT", None)
         v = verifier_mod.default_verifier()
-        assert isinstance(v, ResilientVerifier)
+        # the coalescing facade is always outermost; the resilient wrap
+        # appears underneath it when faults are armed
+        assert isinstance(v, CoalescingVerifier)
+        assert isinstance(v.inner, ResilientVerifier)
         h = hasher_mod.auto_hasher()
         assert isinstance(h, ResilientTreeHasher)
         monkeypatch.setattr(verifier_mod, "_DEFAULT", None)
         fail.clear_device_faults()
         v2 = verifier_mod.default_verifier()
-        assert isinstance(v2, HostBatchVerifier)  # CPU, no faults armed
+        assert isinstance(v2, CoalescingVerifier)
+        assert isinstance(v2.inner, HostBatchVerifier)  # CPU, no faults armed
 
 
 class TestTableBuildBreaker:

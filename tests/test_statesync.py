@@ -25,6 +25,7 @@ from tendermint_tpu.statesync.snapshot import (
     decode_payload,
     split_chunks,
     verify_chunks,
+    verify_chunks_async,
 )
 from tendermint_tpu.statesync.reactor import ChunkPool
 from tendermint_tpu.statesync.trust import TrustAnchor, TrustOptions
@@ -132,6 +133,89 @@ class TestChunkVerification:
         assert app == b"app-bytes"
         assert tail == []
         assert b"".join(split_chunks(payload, 7)) == payload
+
+
+class TestChunkVerifyAsyncGate:
+    """The chunk-verify gate as a dispatch handle (ROADMAP dispatch
+    follow-up): hashing launches through the hasher's async seam, the
+    comparison + root fold run at the join, and device faults degrade
+    to host hashlib INSIDE the handle — the restore path overlaps
+    payload decode with the in-flight launch either way."""
+
+    def _take(self):
+        st = _snapshot_state()
+        store = SnapshotStore(MemDB(), hasher=HOST_HASHER, chunk_size=100)
+        m = store.take(st, b"app" * 400)
+        chunks = [store.load_chunk(m.height, m.format, i) for i in range(m.chunks)]
+        return m, chunks
+
+    def test_clean_set_resolves_true_at_join(self):
+        m, chunks = self._take()
+        gate = verify_chunks_async(m, chunks, HOST_HASHER)
+        assert gate.result() is True
+
+    def test_corrupt_chunk_raises_at_join_not_submit(self):
+        m, chunks = self._take()
+        chunks[1] = bytes(b ^ 0xFF for b in chunks[1])
+        gate = verify_chunks_async(m, chunks, HOST_HASHER)  # must not raise
+        with pytest.raises(ValidationError, match="chunk 1"):
+            gate.result()
+
+    def test_wrong_count_is_an_error_handle(self):
+        m, chunks = self._take()
+        gate = verify_chunks_async(m, chunks[:-1], HOST_HASHER)
+        with pytest.raises(ValidationError, match="chunks"):
+            gate.result()
+
+    def test_routes_through_the_hashers_async_seam(self):
+        from tendermint_tpu.services.dispatch import DispatchQueue
+
+        class _Recording(TreeHasher):
+            def __init__(self):
+                super().__init__(backend="host")
+                self.async_calls = 0
+
+            def leaf_hashes_async(self, items, queue=None):
+                self.async_calls += 1
+                return super().leaf_hashes_async(items, queue=queue)
+
+        hasher = _Recording()
+        m, chunks = self._take()
+        q = DispatchQueue(depth=2, name="test-chunk-gate")
+        try:
+            assert verify_chunks_async(m, chunks, hasher, queue=q).result() is True
+        finally:
+            q.close()
+        assert hasher.async_calls == 1
+
+    def test_device_fault_degrades_inside_the_gate(self):
+        from tendermint_tpu.services.dispatch import DispatchQueue
+        from tendermint_tpu.services.resilient import ResilientTreeHasher
+        from tendermint_tpu.utils.circuit import OPEN, CircuitBreaker
+
+        rh = ResilientTreeHasher(
+            TreeHasher(backend="host"),
+            breaker=CircuitBreaker(failure_threshold=1, reset_timeout_s=60),
+            max_retries=0,
+        )
+        fail.set_device_fault("hash")
+        m, chunks = self._take()
+        q = DispatchQueue(depth=2, name="test-chunk-gate-fault")
+        try:
+            # faulted launch re-hashes on host inside the handle: the
+            # gate still verdicts, nothing raises into the restore path
+            assert verify_chunks_async(m, chunks, rh, queue=q).result() is True
+        finally:
+            q.close()
+        assert rh.breaker.state == OPEN
+        # and a corrupt chunk is still caught while degraded
+        chunks[0] = b"garbage" + chunks[0][7:]
+        q2 = DispatchQueue(depth=2, name="test-chunk-gate-fault2")
+        try:
+            with pytest.raises(ValidationError, match="chunk 0"):
+                verify_chunks_async(m, chunks, rh, queue=q2).result()
+        finally:
+            q2.close()
 
 
 class TestSnapshotStore:

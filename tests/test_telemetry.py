@@ -346,3 +346,85 @@ class TestBenchHotpath:
         assert host["signatures"] >= 8 + 16  # this run's (registry may hold more)
         assert data["detail"]["wal_fsync"]["count"] >= 16
         assert data["detail"]["hash"]["host"]["leaves_per_s"] > 0
+
+
+class TestSpanPersistence:
+    """Span timelines survive restarts: bounded JSONL ring under the
+    data dir, replayed into the tracer on boot (ROADMAP observability
+    follow-up)."""
+
+    def test_sink_appends_and_load_roundtrips(self, tmp_path):
+        from tendermint_tpu.telemetry.spanlog import SpanLog
+
+        tr = Tracer(capacity=16)
+        log = SpanLog(str(tmp_path / "spans.jsonl"), capacity=16)
+        tr.set_sink(log.append)
+        tr.add("consensus.propose", 1.0, 2.0, height=7)
+        tr.add("verify.batch", 2.0, 2.5, n=64)
+        tr.clear_sink(log.append)
+        log.close()
+        loaded = SpanLog(str(tmp_path / "spans.jsonl"), capacity=16).load()
+        assert [d["name"] for d in loaded] == [
+            "consensus.propose",
+            "verify.batch",
+        ]
+        assert loaded[0]["attrs"]["height"] == 7
+
+    def test_ring_compacts_to_capacity(self, tmp_path):
+        from tendermint_tpu.telemetry.spanlog import SpanLog
+
+        path = str(tmp_path / "spans.jsonl")
+        log = SpanLog(path, capacity=8)
+        tr = Tracer(capacity=64)
+        tr.set_sink(log.append)
+        for i in range(40):
+            tr.add("s", float(i), float(i) + 0.5, i=i)
+        log.close()
+        loaded = SpanLog(path, capacity=8).load()
+        assert len(loaded) <= 8
+        # the NEWEST spans survive compaction
+        assert loaded[-1]["attrs"]["i"] == 39
+
+    def test_persist_spans_replays_then_sinks(self, tmp_path):
+        from tendermint_tpu.telemetry.spanlog import SpanLog, persist_spans
+
+        path = str(tmp_path / "spans.jsonl")
+        first = SpanLog(path, capacity=32)
+        tr0 = Tracer(capacity=32)
+        tr0.set_sink(first.append)
+        tr0.add("consensus.commit", 10.0, 11.0, height=42)
+        first.close()
+
+        # "restart": a fresh tracer replays the persisted window and
+        # keeps persisting new spans
+        tr1 = Tracer(capacity=32)
+        log = persist_spans(tr1, path, capacity=32)
+        restored = tr1.recent()
+        assert restored[0]["name"] == "consensus.commit"
+        assert restored[0]["attrs"]["restored"] is True
+        assert restored[0]["attrs"]["height"] == 42
+        tr1.add("consensus.propose", 11.0, 12.0, height=43)
+        tr1.clear_sink(log.append)
+        log.close()
+        names = [d["name"] for d in SpanLog(path, capacity=32).load()]
+        # the replayed span is NOT re-appended; the new one is
+        assert names == ["consensus.commit", "consensus.propose"]
+
+    def test_torn_final_line_is_skipped(self, tmp_path):
+        from tendermint_tpu.telemetry.spanlog import SpanLog
+
+        path = tmp_path / "spans.jsonl"
+        path.write_text(
+            '{"name":"ok","start":1.0,"end":2.0}\n{"name":"torn","sta'
+        )
+        loaded = SpanLog(str(path), capacity=8).load()
+        assert [d["name"] for d in loaded] == ["ok"]
+
+    def test_clear_sink_only_removes_own_sink(self):
+        tr = Tracer(capacity=4)
+        mine, theirs = [], []
+        tr.set_sink(mine.append)
+        tr.set_sink(theirs.append)  # a successor took over
+        tr.clear_sink(mine.append)  # stopping node must not strip it
+        tr.add("s", 0.0, 1.0)
+        assert len(theirs) == 1 and not mine

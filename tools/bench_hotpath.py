@@ -296,6 +296,132 @@ def drive_fastsync_pipeline(
     }
 
 
+def _salted_sigs(n: int, salt: bytes):
+    """Like `_make_sigs` but with per-call-unique messages, so replay
+    loops control exactly which triples repeat."""
+    from tendermint_tpu.crypto.keys import gen_priv_key
+
+    privs = [gen_priv_key(bytes([i % 256]) * 32) for i in range(min(64, n))]
+    msgs = [
+        b'{"chain_id":"hotpath","salt":"%s","vote":{"index":%d}}' % (salt, i)
+        for i in range(n)
+    ]
+    sigs = [privs[i % len(privs)].sign(m) for i, m in enumerate(msgs)]
+    pubs = [privs[i % len(privs)].pub_key.data for i in range(n)]
+    return list(zip(pubs, msgs, sigs))
+
+
+def drive_dedup_steady_state(heights: int, n_vals: int, launch_ms: float) -> dict:
+    """Gossip-then-commit height replay through the dedup cache: each
+    height's votes are verified once on gossip arrival and again when
+    the commit seals the block — the exact redundancy the cache exists
+    to remove. Cache-off pays the emulated launch twice per height
+    (same CPU method as `fastsync_pipeline`); cache-on serves the
+    commit pass from proven triples."""
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+
+    height_triples = [
+        _salted_sigs(n_vals, b"h%d" % h) for h in range(heights)
+    ]
+
+    def run(cache_size: int) -> float:
+        v = CoalescingVerifier(
+            _LaunchLatencyVerifier(launch_ms / 1e3),
+            cache_size=cache_size,
+            window_s=0.001,
+        )
+        try:
+            total = 0
+            t0 = time.perf_counter()
+            for triples in height_triples:
+                assert bool(v.verify_batch(triples).all())  # gossip drain
+                assert bool(v.verify_batch(triples).all())  # commit seal
+                total += 2 * len(triples)
+            return total / (time.perf_counter() - t0)
+        finally:
+            v.close()
+
+    def _cache_hits() -> float:
+        from tendermint_tpu.telemetry import REGISTRY
+
+        return REGISTRY.counter_value("tendermint_verify_cache_hits_total")
+
+    off_vps = run(cache_size=0)
+    h0 = _cache_hits()
+    on_vps = run(cache_size=65536)
+    return {
+        "heights": heights,
+        "validators": n_vals,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "cache_off_verifies_per_s": round(off_vps, 1),
+        "cache_on_verifies_per_s": round(on_vps, 1),
+        "speedup": round(on_vps / off_vps, 3),
+        "cache_hits": int(_cache_hits() - h0),
+    }
+
+
+def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> dict:
+    """All four verify consumers live at once: consensus, fast-sync,
+    statesync, and rpc threads submit concurrent async batches through
+    one coalescer; the coalesce factor (requests merged per launch) is
+    read back from the telemetry the coalescer exports."""
+    import threading
+
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+
+    consumers = ("consensus", "fastsync", "statesync", "rpc")
+    pre = {
+        tag: [
+            _salted_sigs(batch, b"%s-r%d" % (tag.encode(), r))
+            for r in range(rounds)
+        ]
+        for tag in consumers
+    }
+    v = CoalescingVerifier(
+        _LaunchLatencyVerifier(launch_ms / 1e3), cache_size=0, window_s=0.005
+    )
+    n0, s0, _, _ = _histo("tendermint_batcher_coalesce_factor")
+    gate = threading.Barrier(len(consumers))
+    errors: list = []
+
+    def worker(tag: str) -> None:
+        try:
+            for triples in pre[tag]:
+                gate.wait()  # align the four consumers' submit instants
+                if not v.verify_batch_async(triples, consumer=tag).result(
+                    timeout=60
+                ).all():
+                    errors.append(f"{tag}: bad verdict")
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(f"{tag}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(tag,)) for tag in consumers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    v.close()
+    assert not errors, errors
+    n1, s1, _, _ = _histo("tendermint_batcher_coalesce_factor")
+    launches = n1 - n0
+    factor = (s1 - s0) / launches if launches else 0.0
+    total = len(consumers) * rounds * batch
+    return {
+        "consumers": list(consumers),
+        "rounds": rounds,
+        "batch_per_request": batch,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "verifies_per_s": round(total / dt, 1),
+        "coalesced_launches": int(launches),
+        "requests": len(consumers) * rounds,
+        "coalesce_factor_mean": round(factor, 3),
+    }
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -393,6 +519,34 @@ def main(argv=None) -> int:
         help="validators signing each bench commit",
     )
     ap.add_argument(
+        "--dedup-heights",
+        type=int,
+        default=4,
+        dest="dedup_heights",
+        help="heights replayed through the gossip-then-commit dedup bench (0 skips)",
+    )
+    ap.add_argument(
+        "--dedup-vals",
+        type=int,
+        default=64,
+        dest="dedup_vals",
+        help="validators signing each dedup-bench height",
+    )
+    ap.add_argument(
+        "--coalesce-rounds",
+        type=int,
+        default=6,
+        dest="coalesce_rounds",
+        help="rounds each of the four consumers drives through the coalescer (0 skips)",
+    )
+    ap.add_argument(
+        "--coalesce-batch",
+        type=int,
+        default=32,
+        dest="coalesce_batch",
+        help="signatures per consumer request in the coalesce bench",
+    )
+    ap.add_argument(
         "--launch-ms",
         type=float,
         default=86.0,
@@ -448,6 +602,24 @@ def main(argv=None) -> int:
         fastsync_pipeline = drive_fastsync_pipeline(
             args.fastsync_blocks, args.fastsync_vals, args.launch_ms, on_device
         )
+    dedup_steady_state = None
+    if args.dedup_heights > 0:
+        sys.stderr.write(
+            f"driving dedup steady-state {args.dedup_heights} heights x "
+            f"{args.dedup_vals} vals (cache off vs on)...\n"
+        )
+        dedup_steady_state = drive_dedup_steady_state(
+            args.dedup_heights, args.dedup_vals, args.launch_ms
+        )
+    coalesce_multiconsumer = None
+    if args.coalesce_rounds > 0:
+        sys.stderr.write(
+            f"driving 4-consumer coalescer {args.coalesce_rounds} rounds x "
+            f"{args.coalesce_batch} sigs...\n"
+        )
+        coalesce_multiconsumer = drive_coalesce_multiconsumer(
+            args.coalesce_rounds, args.coalesce_batch, args.launch_ms
+        )
 
     wal_count, wal_sum, wal_p50, wal_p99 = _histo("tendermint_wal_fsync_seconds")
     detail = {
@@ -457,6 +629,8 @@ def main(argv=None) -> int:
         "hash": hash_summaries,
         "statesync": statesync_summary(),
         "fastsync_pipeline": fastsync_pipeline,
+        "dedup_steady_state": dedup_steady_state,
+        "coalesce_multiconsumer": coalesce_multiconsumer,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
