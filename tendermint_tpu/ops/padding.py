@@ -128,6 +128,38 @@ def pad_ripemd160_prefixed(
     return out, counts
 
 
+def pad_rows_to(arrays: list[np.ndarray], size: int) -> list[np.ndarray]:
+    """Zero-pad every array's leading (row) axis up to exactly `size`.
+
+    The mesh shard path pads lane batches to a per-chip-bucket multiple
+    of the mesh size with zero rows: a zero ed25519 row verifies False
+    on device (see `parallel.mesh.pad_to_multiple`) and a zero leaf row
+    carries n_blocks=0, so pad rows can never leak a True verdict or a
+    real digest — callers slice outputs back to the true row count.
+    """
+    out = []
+    for a in arrays:
+        n = a.shape[0]
+        if n == size:
+            out.append(a)
+            continue
+        if n > size:
+            raise ValueError(f"cannot pad {n} rows down to {size}")
+        pad = np.zeros((size - n,) + a.shape[1:], dtype=a.dtype)
+        out.append(np.concatenate([a, pad]))
+    return out
+
+
+def pad_rows_to_multiple(
+    arrays: list[np.ndarray], multiple: int
+) -> tuple[list[np.ndarray], int]:
+    """Zero-pad row counts up to the next multiple of `multiple`;
+    returns (padded arrays, true row count) for post-kernel slicing."""
+    n = arrays[0].shape[0]
+    size = ((n + multiple - 1) // multiple) * multiple
+    return pad_rows_to(arrays, size), n
+
+
 def digests_to_bytes_be(digests: np.ndarray) -> list[bytes]:
     """(B, W) u32 big-endian word digests -> list of byte digests."""
     arr = np.asarray(digests, dtype=np.uint32)

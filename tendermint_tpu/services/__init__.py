@@ -14,6 +14,8 @@ from tendermint_tpu.services.verifier import (
     BatchVerifier,
     DeviceBatchVerifier,
     HostBatchVerifier,
+    ShardedBatchVerifier,
+    ShardedTableBatchVerifier,
     TableBatchVerifier,
     default_verifier,
 )
@@ -24,6 +26,8 @@ __all__ = [
     "HostBatchVerifier",
     "ResilientTreeHasher",
     "ResilientVerifier",
+    "ShardedBatchVerifier",
+    "ShardedTableBatchVerifier",
     "TableBatchVerifier",
     "TreeHasher",
     "default_verifier",

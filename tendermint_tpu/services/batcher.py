@@ -70,6 +70,16 @@ _WINDOW_REFRESH_FLUSHES = 32
 _STOP = object()
 
 
+def _mesh_width(verifier) -> int:
+    """Chips behind a verifier stack (1 for single-device backends):
+    walks the `mesh` passthrough the resilient/sharded layers export."""
+    mesh = getattr(verifier, "mesh", None)
+    if mesh is None:
+        mesh = getattr(getattr(verifier, "primary", None), "mesh", None)
+    n = getattr(mesh, "n_total", 1)
+    return max(1, int(n) if n else 1)
+
+
 def consumer_kwargs(verifier, consumer: str) -> dict:
     """`{"consumer": ...}` when `verifier` advertises the tag surface
     (every in-tree BatchVerifier), `{}` for minimal test fakes — call
@@ -236,7 +246,10 @@ def _adaptive_window_s() -> float:
     launch_mean = None
     fam = REGISTRY.get("tendermint_verify_seconds")
     if fam is not None:
-        for backend in ("tables", "device", "host"):
+        # "mesh" first: when the sharded backend is live its launch
+        # cost (which includes the cross-chip dispatch) is the one the
+        # window amortizes
+        for backend in ("mesh", "tables", "device", "host"):
             snap = fam.labels(backend=backend).value
             if snap["count"]:
                 launch_mean = snap["sum"] / snap["count"]
@@ -534,6 +547,12 @@ class CoalescingVerifier(BatchVerifier):
         self.inner = inner
         cache = VerifiedSigCache(cache_size)
         self.cache = cache if cache.enabled else None
+        if max_batch is None:
+            # A merged launch should be able to FILL the whole mesh:
+            # the per-launch cap is per-chip, so N chips coalesce N
+            # windows' worth before the size trigger fires (the env
+            # knob stays a per-chip figure either way).
+            max_batch = MAX_COALESCED_BATCH * _mesh_width(inner)
         self.coalescer = VerifyCoalescer(
             inner, self.cache, max_batch=max_batch, window_s=window_s
         )

@@ -41,6 +41,7 @@ class TreeHasher:
         backend: str = "device",
         algo: str = "sha256",
         min_device_leaves: int | None = None,
+        mesh=None,
     ) -> None:
         if backend not in ("device", "host"):
             raise ValueError(f"unknown backend {backend!r}")
@@ -54,6 +55,12 @@ class TreeHasher:
         self.min_device_leaves = (
             DEVICE_MIN_LEAVES if min_device_leaves is None else min_device_leaves
         )
+        # Optional `parallel.mesh.MeshManager`: the LEAF hashing lane
+        # (the O(N) term — statesync chunk gates, big-block leaf
+        # passes) shards over the mesh; tree reduction stays
+        # single-device (inner levels halve too fast to amortize
+        # collectives). None = single-device legacy.
+        self.mesh = mesh
 
     def _use_device(self, n: int) -> bool:
         return self.backend == "device" and n >= max(2, self.min_device_leaves)
@@ -102,10 +109,17 @@ class TreeHasher:
 
     def leaf_hashes(self, items: list[bytes]) -> list[bytes]:
         """Per-item domain-separated leaf hashes (state-sync chunk
-        verification) — one batched device launch above the threshold,
+        verification) — one batched device launch above the threshold
+        (sharded over every active mesh chip when a mesh is attached),
         host hashlib below it."""
         t0 = time.perf_counter()
         if self._use_device(len(items)):
+            if self.mesh is not None and self.mesh.n_total > 1:
+                from tendermint_tpu.ops.merkle_kernel import leaf_hashes_sharded
+
+                out = leaf_hashes_sharded(items, self.algo, self.mesh)
+                _observe_hash("mesh", len(items), time.perf_counter() - t0)
+                return out
             from tendermint_tpu.ops.merkle_kernel import leaf_hashes_device
 
             out = leaf_hashes_device(items, self.algo)
@@ -157,12 +171,29 @@ def auto_hasher() -> TreeHasher:
     """
     import jax
 
+    from tendermint_tpu.services.verifier import _mesh_opt_in_cpu
     from tendermint_tpu.utils.fail import device_faults_armed
 
     if jax.default_backend() == "tpu":
+        from tendermint_tpu.parallel.mesh import (
+            default_mesh_manager,
+            mesh_device_count,
+        )
         from tendermint_tpu.services.resilient import ResilientTreeHasher
 
-        return ResilientTreeHasher(TreeHasher(backend="device"))
+        mesh = default_mesh_manager() if mesh_device_count() > 1 else None
+        return ResilientTreeHasher(TreeHasher(backend="device", mesh=mesh))
+    if _mesh_opt_in_cpu():
+        # the CPU virtual-device recipe (docs/PLATFORM_NOTES.md): the
+        # leaf lane shards over the forced mesh, breaker-wrapped like
+        # the TPU composition so chaos tests drive the same path
+        from tendermint_tpu.parallel.mesh import default_mesh_manager
+        from tendermint_tpu.services.resilient import ResilientTreeHasher
+
+        return ResilientTreeHasher(
+            TreeHasher(backend="device", mesh=default_mesh_manager()),
+            TreeHasher(backend="host"),
+        )
     if device_faults_armed():
         from tendermint_tpu.services.resilient import ResilientTreeHasher
 

@@ -281,8 +281,19 @@ class ResilientVerifier(BatchVerifier):
     def degraded(self) -> bool:
         return self._dispatch.breaker.state != "closed"
 
+    @property
+    def mesh(self):
+        """The primary's `MeshManager` when the device backend is
+        sharded (None otherwise) — the coalescer reads the mesh size to
+        scale its merge windows, dashboards read the snapshot."""
+        return getattr(self.primary, "mesh", None)
+
     def snapshot(self) -> dict:
-        return self._dispatch.snapshot()
+        out = self._dispatch.snapshot()
+        mesh = self.mesh
+        if mesh is not None:
+            out["mesh"] = mesh.snapshot()
+        return out
 
     def verify_batch(self, triples: Sequence[Triple]) -> np.ndarray:
         return self._dispatch.call(
@@ -396,6 +407,7 @@ class ResilientTreeHasher(TreeHasher):
             backend=primary.backend,
             algo=primary.algo,
             min_device_leaves=primary.min_device_leaves,
+            mesh=primary.mesh,
         )
         self.primary = primary
         self.fallback = (

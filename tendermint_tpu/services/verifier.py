@@ -534,14 +534,372 @@ class TableBatchVerifier(DeviceBatchVerifier):
         return out
 
 
+class _MeshFlatMixin:
+    """Shared mesh plumbing for the sharded verifier backends: the flat
+    (pub, r, s, h) batch lane over `parallel.mesh.MeshManager`, with the
+    survivor re-mesh loop around every launch.
+
+    Geometry: rows are padded to `per_shard_bucket * n_active` where the
+    per-shard bucket is the power-of-two `bucket_size` of the per-chip
+    share (min 8) — compiled executables are keyed by PER-CHIP shard
+    shape, so the same buckets serve any mesh size and a survivor
+    re-mesh only recompiles the step, not a new zoo of shapes.
+    """
+
+    mesh = None  # set by subclass __init__
+
+    def _mesh_flat_launch(self, pub, r, s, h, powers):
+        """One sharded launch over the active mesh; re-meshes onto
+        survivors on an attributable shard fault, raises
+        `MeshExhaustedError` (into the caller's breaker) when no
+        devices remain. Returns (verdict, tally) un-materialized."""
+        from tendermint_tpu.ops.ed25519_kernel import bucket_size
+        from tendermint_tpu.ops.padding import pad_rows_to
+        from tendermint_tpu.parallel.mesh import MeshExhaustedError
+        from tendermint_tpu.utils.fail import ShardDeviceFault
+
+        m = self.mesh
+        m.maybe_reprobe()
+        n = pub.shape[0]
+        while True:
+            ndev = m.n_active
+            if ndev == 0:
+                raise MeshExhaustedError(
+                    f"all {m.n_total} mesh devices faulted"
+                )
+            try:
+                m.check_shard_faults()
+                size = bucket_size(-(-n // ndev)) * ndev
+                arrs = pad_rows_to([pub, r, s, h, powers], size)
+                step = m.verify_step()
+                ok, total = step(*arrs)
+                return ok, total
+            except ShardDeviceFault as e:
+                if not m.record_shard_fault(e.shard):
+                    raise MeshExhaustedError(
+                        f"all {m.n_total} mesh devices faulted"
+                    ) from e
+
+    def launch_verify_batch(self, triples):
+        """Flat-batch async half: host prep + ONE launch sharded over
+        every active chip (the coalescer's merged batches land here —
+        one logical device that is actually N chips). Sub-threshold
+        batches and single-device meshes take the legacy paths."""
+        if self.mesh.n_total <= 1:
+            return super().launch_verify_batch(triples)
+        if not triples:
+            return ("host", np.zeros(0, dtype=bool))
+        if len(triples) < self._min_batch:
+            return ("host", self._host.verify_batch(triples))
+        from tendermint_tpu.ops.ed25519_kernel import prepare_batch
+
+        pubs, msgs, sigs = zip(*triples)
+        t0 = time.perf_counter()
+        pub, r, s, h, precheck = prepare_batch(pubs, msgs, sigs)
+        powers = np.zeros(len(triples), dtype=np.int32)
+        ok, _total = self._mesh_flat_launch(pub, r, s, h, powers)
+        return ("mesh", ok, precheck, len(triples), t0)
+
+    def finalize_verify_batch(self, launched) -> np.ndarray:
+        if launched[0] != "mesh":
+            return super().finalize_verify_batch(launched)
+        _tag, ok, precheck, n, t0 = launched
+        out = np.asarray(ok)[:n] & precheck
+        _observe_verify("mesh", n, time.perf_counter() - t0)
+        return out
+
+    def verify_batch_with_powers(self, triples, powers):
+        """Commit-tally lane: per-item verdicts PLUS the psum-reduced
+        verified-power total computed on device across all shards (the
+        `sharded_verify_and_tally` collective — no host gather for the
+        2/3-quorum sum). Pad rows and precheck-failed rows carry zero
+        bytes, verify False on every backend, and so never contribute
+        power."""
+        from tendermint_tpu.ops.ed25519_kernel import prepare_batch
+
+        if not triples:
+            return np.zeros(0, dtype=bool), 0
+        pubs, msgs, sigs = zip(*triples)
+        t0 = time.perf_counter()
+        pub, r, s, h, precheck = prepare_batch(pubs, msgs, sigs)
+        pw = np.asarray(powers, dtype=np.int32) * precheck
+        ok, total = self._mesh_flat_launch(pub, r, s, h, pw)
+        mask = np.asarray(ok)[: len(triples)] & precheck
+        _observe_verify("mesh", len(triples), time.perf_counter() - t0)
+        return mask, int(total)
+
+    def snapshot(self) -> dict:
+        return {"mesh": self.mesh.snapshot()}
+
+
+class ShardedBatchVerifier(_MeshFlatMixin, DeviceBatchVerifier):
+    """Generic-ladder batch verifier sharded over a device mesh.
+
+    The CPU-mesh / ad-hoc-triple production backend: every launch splits
+    the batch axis over the active chips of a `MeshManager` and psums
+    the power tally. Commit grids flatten their present lanes into the
+    same flat mesh lane and scatter verdicts back — so fast-sync windows
+    and consensus commits ride N chips even without valset tables.
+    """
+
+    def __init__(self, mesh=None, min_device_batch: int | None = None) -> None:
+        super().__init__(min_device_batch)
+        from tendermint_tpu.parallel.mesh import MeshManager
+
+        self.mesh = mesh if mesh is not None else MeshManager()
+
+    def verify_commits(self, pubkeys, commits, force_fused=None) -> np.ndarray:
+        return self.finalize_verify_commits(
+            self.launch_verify_commits(pubkeys, commits, force_fused=force_fused)
+        )
+
+    def launch_verify_commits(self, pubkeys, commits, force_fused=None):
+        """Commit grids as flat mesh lanes: present (msg, sig) lanes
+        flatten into one batch-sharded launch (`force_fused` is a
+        single-device-tables concept; ignored here)."""
+        n, k = len(pubkeys), len(commits)
+        lanes: list[tuple[int, int]] = []
+        triples: list[Triple] = []
+        for ci, (msgs, sigs) in enumerate(commits):
+            for i in range(n):
+                if msgs[i] is not None and sigs[i] is not None:
+                    lanes.append((ci, i))
+                    triples.append((pubkeys[i], msgs[i], sigs[i]))
+        if self.mesh.n_total <= 1 or len(triples) < self._min_batch:
+            grid = np.zeros((k, n), dtype=bool)
+            if triples:
+                verdicts = self._host.verify_batch(triples)
+                for (ci, i), ok in zip(lanes, verdicts):
+                    grid[ci, i] = bool(ok)
+            return ("host_grid", grid)
+        launched = self.launch_verify_batch(triples)
+        return ("mesh_grid", launched, lanes, k, n)
+
+    def finalize_verify_commits(self, launched) -> np.ndarray:
+        if launched[0] == "host_grid":
+            return launched[1]
+        _tag, flat, lanes, k, n = launched
+        mask = self.finalize_verify_batch(flat)
+        grid = np.zeros((k, n), dtype=bool)
+        for (ci, i), ok in zip(lanes, mask):
+            grid[ci, i] = bool(ok)
+        return grid
+
+    def verify_commits_async(
+        self, pubkeys, commits, queue=None, force_fused=None, consumer="default"
+    ):
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(
+            lambda: self.launch_verify_commits(
+                pubkeys, commits, force_fused=force_fused
+            ),
+            self.finalize_verify_commits,
+            kind="verify",
+        )
+
+
+class ShardedTableBatchVerifier(_MeshFlatMixin, TableBatchVerifier):
+    """The mesh-aware TABLE fast path: the TPU steady-state backend.
+
+    Commit-grid verification shards along the VALIDATOR axis
+    (`parallel.mesh.sharded_tables_verify_and_tally`): each chip holds
+    1/ndev of the cached comb-table columns plus the lanes of its own
+    validators for every stacked commit, and the power tally psums over
+    ICI. Stack/chunk geometry derives from the PER-CHIP shard size —
+    the fused-pallas selection inside `verify_tables_kernel` sees
+    per-shard shapes under shard_map, and the host-side K padding uses
+    per-chip lane counts, not the global batch (the single-device
+    assumption this class exists to remove).
+
+    Falls back per-call to the single-device table path when the valset
+    does not split evenly over the active chips (N % ndev != 0), and to
+    flat mesh lanes when the mesh executor has no table program (the
+    host-emulated CPU seam).
+    """
+
+    def __init__(
+        self,
+        mesh=None,
+        cache_size: int = 4,
+        min_device_batch: int | None = None,
+    ) -> None:
+        super().__init__(cache_size=cache_size, min_device_batch=min_device_batch)
+        from tendermint_tpu.parallel.mesh import MeshManager
+
+        self.mesh = mesh if mesh is not None else MeshManager()
+        # (valset key, active device tuple) -> mesh-sharded table array;
+        # re-sharding 1.25 GB of tables per launch would eat the win
+        self._sharded_tables: dict = {}
+
+    def _tables_for_mesh(self, pubkeys: tuple[bytes, ...], mesh_obj):
+        """Valset tables placed WITH the validator-axis sharding for the
+        active mesh (cached per device set; the underlying build rides
+        the same table-build breaker as the single-device path)."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        from tendermint_tpu.parallel.mesh import BATCH_AXIS
+
+        tables, key_ok = self._tables_for(pubkeys)
+        key = (self._cache_key(pubkeys), tuple(mesh_obj.devices.flat))
+        with self._cache_lock:
+            hit = self._sharded_tables.get(key)
+        if hit is not None:
+            return hit, key_ok
+        sharding = NamedSharding(mesh_obj, _P(None, None, None, BATCH_AXIS))
+        placed = _jax.device_put(tables, sharding)
+        with self._cache_lock:
+            self._sharded_tables[key] = placed
+            while len(self._sharded_tables) > self._cache_size * 2:
+                self._sharded_tables.pop(next(iter(self._sharded_tables)))
+        return placed, key_ok
+
+    def launch_verify_commits(self, pubkeys, commits, force_fused=None):
+        n, k = len(pubkeys), len(commits)
+        m = self.mesh
+        if m.n_total <= 1:
+            return super().launch_verify_commits(
+                pubkeys, commits, force_fused=force_fused
+            )
+        if n == 0 or k == 0:
+            return ("host", np.zeros((k, n), dtype=bool))
+        if k * n < self._min_batch:
+            return ("host", self._host_commit_loop(pubkeys, commits))
+        if m.executor != "device":
+            # host-emulated mesh: flat lanes keep the fault/re-mesh
+            # choreography testable without the tables program
+            return ShardedBatchVerifier.launch_verify_commits(
+                self, pubkeys, commits
+            )
+        m.maybe_reprobe()
+        from tendermint_tpu.parallel.mesh import MeshExhaustedError
+        from tendermint_tpu.utils.fail import ShardDeviceFault
+
+        while True:
+            if m.n_active == 0:
+                raise MeshExhaustedError(
+                    f"all {m.n_total} mesh devices faulted"
+                )
+            if n % m.n_active != 0:
+                # uneven split: per-call fallback to the single-device
+                # table path (still breaker-guarded upstream)
+                return super().launch_verify_commits(
+                    pubkeys, commits, force_fused=force_fused
+                )
+            try:
+                m.check_shard_faults()
+                return self._launch_mesh_tables(
+                    pubkeys, commits, force_fused=force_fused
+                )
+            except ShardDeviceFault as e:
+                if not m.record_shard_fault(e.shard):
+                    raise MeshExhaustedError(
+                        f"all {m.n_total} mesh devices faulted"
+                    ) from e
+
+    def _launch_mesh_tables(self, pubkeys, commits, force_fused=None):
+        from tendermint_tpu.ops.ed25519_tables import prepare_commit_lanes
+        from tendermint_tpu.parallel.mesh import shard_lanes_validator_major
+
+        n, k = len(pubkeys), len(commits)
+        m = self.mesh
+        ndev = m.n_active
+        length_ok = np.array([len(pk) == 32 for pk in pubkeys], dtype=bool)
+        if not length_ok.all():
+            placeholder = b"\x01" + b"\x00" * 31
+            pubkeys = [
+                pk if ok else placeholder for pk, ok in zip(pubkeys, length_ok)
+            ]
+        try:
+            tables, key_ok = self._tables_for_mesh(tuple(pubkeys), m.mesh())
+        except TableBuildError:
+            return ("host", self._host_commit_loop(pubkeys, commits))
+        key_ok = key_ok & length_ok
+        # Stack/chunk geometry from the PER-CHIP lane count: the fused
+        # plane shape inside verify_tables_kernel sees n/ndev columns
+        # per shard under shard_map, so fusability and the K padding
+        # rule use shard_n, not the global validator count (absent-vote
+        # pad commits verify False via precheck, sliced off at finalize)
+        import jax as _jax
+
+        from tendermint_tpu.ops.ed25519_tables import MAX_FUSED_STACK
+
+        shard_n = n // ndev
+        fusable = (
+            (shard_n % 128 == 0 and k >= 8 and _jax.default_backend() == "tpu")
+            if force_fused is None
+            else force_fused
+        )
+        step = m.tables_step()
+        chunk = MAX_FUSED_STACK if fusable else k
+        launches = []  # (device_ok, real, part_len) per chunk
+        t0 = time.perf_counter()
+        for lo in range(0, k, chunk):
+            part = list(commits[lo : lo + chunk])
+            real = len(part)
+            if fusable and real % 8 != 0:
+                absent = ([None] * n, [None] * n)
+                part.extend([absent] * (8 - real % 8))
+            s, h, r, precheck = prepare_commit_lanes(pubkeys, part)
+            lane_ok = precheck & np.tile(key_ok, len(part))
+            powers = np.ones(len(part) * n, dtype=np.int32)
+            s, h, r, lane_ok_s, powers = shard_lanes_validator_major(
+                [s, h, r, lane_ok, powers], n, ndev
+            )
+            ok, _total = step(tables, s, h, r, lane_ok_s, powers)
+            launches.append((ok, real, len(part)))
+        return ("mesh_tables", launches, ndev, k, n, t0)
+
+    def finalize_verify_commits(self, launched) -> np.ndarray:
+        if launched[0] in ("host_grid", "mesh_grid"):
+            return ShardedBatchVerifier.finalize_verify_commits(self, launched)
+        if launched[0] != "mesh_tables":
+            return super().finalize_verify_commits(launched)
+        from tendermint_tpu.parallel.mesh import unshard_lanes_validator_major
+
+        _tag, launches, ndev, k, n, t0 = launched
+        rows = []
+        for ok, real, part_len in launches:
+            lanes = unshard_lanes_validator_major(np.asarray(ok), n, ndev)
+            rows.append(lanes.reshape(part_len, n)[:real])
+        _observe_verify("mesh", k * n, time.perf_counter() - t0)
+        return np.concatenate(rows, axis=0)
+
+
 _DEFAULT: BatchVerifier | None = None
 
 
+def _mesh_opt_in_cpu() -> bool:
+    """CPU backends join the sharded mesh only when
+    TENDERMINT_TPU_MESH_DEVICES explicitly asks for >= 2 devices (the
+    virtual-device test recipe, docs/PLATFORM_NOTES.md); on TPU the
+    mesh is the default whenever more than one chip is visible."""
+    knob = os.environ.get("TENDERMINT_TPU_MESH_DEVICES")
+    if not knob or int(knob) < 2:
+        return False
+    from tendermint_tpu.parallel.mesh import mesh_device_count
+
+    return mesh_device_count() > 1
+
+
 def default_verifier() -> BatchVerifier:
-    """Process-wide verifier: device-backed iff an accelerator is up.
+    """Process-wide verifier: device-backed iff an accelerator is up,
+    MESH-backed iff more than one chip is visible.
+
+    On a multi-chip backend the device layer is the sharded mesh stack
+    (`ShardedTableBatchVerifier` over `parallel.mesh.MeshManager`): the
+    coalescer feeds one logical device that is actually N chips, and a
+    per-shard device fault re-meshes onto the survivors before the
+    breaker ever considers host fallback (docs/PERFORMANCE.md "Mesh
+    scale-out"; TENDERMINT_TPU_MESH_DEVICES=1 forces the single-device
+    legacy path).
 
     On CPU-only hosts the emulated curve kernel is far slower than the
-    host crypto library, so fall back to HostBatchVerifier there.
+    host crypto library, so fall back to HostBatchVerifier there —
+    unless TENDERMINT_TPU_MESH_DEVICES >= 2 opts into the sharded stack
+    over virtual devices (the CI mesh recipe, docs/PLATFORM_NOTES.md).
     Consensus paths that don't thread an explicit verifier use this
     (mirrors the reference's package-global crypto functions).
 
@@ -564,16 +922,35 @@ def default_verifier() -> BatchVerifier:
         from tendermint_tpu.utils.fail import device_faults_armed
 
         if jax.default_backend() == "cpu":
-            if device_faults_armed():
+            if _mesh_opt_in_cpu():
+                # CPU hosts (incl. the 8-virtual-device CI mesh) ride
+                # the sharded backend only on explicit opt-in — the
+                # emulated kernels are slower than host crypto, so this
+                # exists for mesh-path testing, not speed
+                from tendermint_tpu.parallel.mesh import default_mesh_manager
                 from tendermint_tpu.services.resilient import ResilientVerifier
 
-                inner: BatchVerifier = ResilientVerifier(DeviceBatchVerifier())
+                inner: BatchVerifier = ResilientVerifier(
+                    ShardedBatchVerifier(mesh=default_mesh_manager())
+                )
+            elif device_faults_armed():
+                from tendermint_tpu.services.resilient import ResilientVerifier
+
+                inner = ResilientVerifier(DeviceBatchVerifier())
             else:
                 inner = HostBatchVerifier()
         else:
+            from tendermint_tpu.parallel.mesh import mesh_device_count
             from tendermint_tpu.services.resilient import ResilientVerifier
 
-            inner = ResilientVerifier(TableBatchVerifier())
+            if mesh_device_count() > 1:
+                from tendermint_tpu.parallel.mesh import default_mesh_manager
+
+                inner = ResilientVerifier(
+                    ShardedTableBatchVerifier(mesh=default_mesh_manager())
+                )
+            else:
+                inner = ResilientVerifier(TableBatchVerifier())
         if os.environ.get("TENDERMINT_TPU_COALESCE", "1") != "0":
             from tendermint_tpu.services.batcher import CoalescingVerifier
 

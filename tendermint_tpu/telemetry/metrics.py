@@ -4,9 +4,9 @@ One module owns all names so the exposition stays consistent and
 greppable (docs/OBSERVABILITY.md is generated from this list by hand —
 keep them in sync). Naming follows the reference's Prometheus
 conventions (`tendermint_consensus_height`, ...); label values are
-low-cardinality by construction: `backend` ∈ {host, device, tables},
-`kind` ∈ {verify, hash, tables}, `phase` ∈ round phases, never peer ids
-or heights.
+low-cardinality by construction: `backend` ∈ {host, device, tables,
+mesh}, `kind` ∈ {verify, hash, tables}, `phase` ∈ round phases, never
+peer ids or heights.
 
 Process-global like the registry: a production process runs ONE node,
 so node-scoped gauges (mempool depth, p2p rates) are process gauges.
@@ -95,6 +95,26 @@ TABLE_CACHE = Counter(
 XLA_CACHE_ENABLED = Gauge(
     "tendermint_xla_persistent_cache_enabled",
     "1 when the persistent XLA executable cache is active",
+)
+
+# -- multi-chip verify mesh (parallel/mesh.py) --------------------------------
+#
+# `direction` is the re-mesh kind: "shrink" (shard fault -> survivors)
+# or "restore" (re-probe brought the full mesh back) — a fixed pair.
+
+MESH_DEVICES = Gauge(
+    "tendermint_mesh_devices",
+    "Devices currently active in the sharded verify/hash mesh",
+)
+MESH_SHARD_FAULTS = Counter(
+    "tendermint_mesh_shard_faults_total",
+    "Per-shard device faults observed by mesh launches",
+)
+MESH_REMESH = Counter(
+    "tendermint_mesh_remesh_total",
+    "Mesh rebuilds (shrink = onto survivors after a shard fault, "
+    "restore = full mesh back after a successful re-probe)",
+    labelnames=("direction",),
 )
 
 # -- resilient dispatch / circuit breaker -------------------------------------
@@ -196,6 +216,8 @@ for _phase in ("prevote", "precommit"):
     CONSENSUS_ROUND_SKIPS.labels(phase=_phase).inc(0)
 for _reason in ("window", "size", "barrier"):
     BATCHER_FLUSH.labels(reason=_reason).inc(0)
+for _direction in ("shrink", "restore"):
+    MESH_REMESH.labels(direction=_direction).inc(0)
 
 # -- state sync ---------------------------------------------------------------
 

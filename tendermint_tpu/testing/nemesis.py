@@ -34,9 +34,12 @@ Fault primitives compose (Jepsen-nemesis style, hence the name):
 
 Degradation cycles are asserted on the EXPORTED telemetry
 (`breaker_baseline` / `assert_breaker_tripped` /
-`assert_breaker_recovered`, plus `wait_telemetry_above` for counters
-like round skips): what an operator's dashboard would show is what the
-chaos suite checks (docs/OBSERVABILITY.md).
+`assert_breaker_recovered` for the host-fallback ladder;
+`mesh_baseline` / `assert_mesh_degraded` / `assert_mesh_restored` for
+the sharded-mesh survivor re-mesh cycle a `shard<i>` fault drives; plus
+`wait_telemetry_above` for counters like round skips): what an
+operator's dashboard would show is what the chaos suite checks
+(docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -637,6 +640,49 @@ class Nemesis:
                 f"breaker[{kind}]: expected >= {min_recoveries} recoveries "
                 f"via telemetry, saw {recoveries}"
             )
+
+    def mesh_baseline(self) -> dict:
+        """Snapshot the sharded-mesh telemetry before injecting a
+        per-shard fault (`TENDERMINT_TPU_DEVICE_FAIL=shard<i>`); pass
+        to `assert_mesh_degraded` / `assert_mesh_restored`."""
+        return {
+            "faults": self.telemetry_value("tendermint_mesh_shard_faults_total"),
+            "shrinks": self.telemetry_value(
+                "tendermint_mesh_remesh_total", direction="shrink"
+            ),
+            "restores": self.telemetry_value(
+                "tendermint_mesh_remesh_total", direction="restore"
+            ),
+        }
+
+    def assert_mesh_degraded(
+        self, baseline: dict, min_faults: int = 1, timeout: float = 30.0
+    ) -> None:
+        """The shrink half of the cycle, via exported telemetry: shard
+        faults observed AND survivor re-meshes performed — the chip
+        loss was absorbed BELOW the breaker."""
+        self.wait_telemetry_above(
+            "tendermint_mesh_shard_faults_total",
+            baseline["faults"] + min_faults - 1,
+            timeout=timeout,
+        )
+        self.wait_telemetry_above(
+            "tendermint_mesh_remesh_total",
+            baseline["shrinks"],
+            timeout=timeout,
+            direction="shrink",
+        )
+
+    def assert_mesh_restored(
+        self, baseline: dict, min_restores: int = 1, timeout: float = 30.0
+    ) -> None:
+        """The recover half: re-probe brought full meshes back."""
+        self.wait_telemetry_above(
+            "tendermint_mesh_remesh_total",
+            baseline["restores"] + min_restores - 1,
+            timeout=timeout,
+            direction="restore",
+        )
 
     def wait_telemetry_above(
         self, name: str, threshold: float, timeout: float = 30.0, **labels

@@ -108,3 +108,37 @@ def device_fail_point(kind: str) -> None:
         if remaining > 0:
             faults[k] = remaining - 1
         raise InjectedDeviceFault(f"injected {kind} device fault")
+
+
+class ShardDeviceFault(InjectedDeviceFault):
+    """A device fault attributable to ONE shard of a mesh (the
+    per-chip analog of InjectedDeviceFault): the mesh layer catches it
+    and re-meshes onto the survivors instead of tripping the whole
+    verify breaker."""
+
+    def __init__(self, shard: int):
+        super().__init__(f"injected device fault on mesh shard {shard}")
+        self.shard = shard
+
+
+def shard_fail_point(indices) -> None:
+    """Per-shard analog of `device_fail_point`: spec entries of the form
+    "shard<i>" (optionally "shard<i>:<count>") fail launches that include
+    device index `i` in their active mesh. Raises `ShardDeviceFault(i)`
+    for the lowest armed index in `indices`, consuming budget."""
+    faults = _load_device_faults()
+    if not faults:
+        return
+    for i in indices:
+        remaining = faults.get(f"shard{i}")
+        if remaining is None or remaining == 0:
+            continue
+        if remaining > 0:
+            faults[f"shard{i}"] = remaining - 1
+        raise ShardDeviceFault(i)
+
+
+def shard_fault_armed(index: int) -> bool:
+    """True while a fault is still armed for mesh shard `index` (the
+    re-probe path peeks without consuming budget)."""
+    return bool(_load_device_faults().get(f"shard{index}"))

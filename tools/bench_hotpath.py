@@ -422,6 +422,58 @@ def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> d
     }
 
 
+def drive_mesh_scaling(batch: int, reps: int, device_counts=(1, 2, 4, 8)) -> dict | None:
+    """`sharded_verify` section: the REAL mesh kernels at mesh widths
+    1/2/4/8 — verifies/s, per-launch commit-tally latency, and scaling
+    efficiency vs linear from the devices=1 figure. On the CPU CI shape
+    the "devices" are XLA virtual host devices (threads over the same
+    cores — expect sub-linear; the section exists so a TPU pod reseeds
+    it with ICI numbers), flagged `virtual_devices`."""
+    import jax
+    import numpy as np
+
+    from tendermint_tpu.parallel.mesh import MeshManager
+    from tendermint_tpu.services.verifier import ShardedBatchVerifier
+
+    have = len(jax.devices())
+    counts = [c for c in device_counts if c <= have]
+    if len(counts) < 2:
+        return None
+    pubs, msgs, sigs = _make_sigs(batch)
+    triples = list(zip(pubs, msgs, sigs))
+    powers = np.full(batch, 3, dtype=np.int32)
+    rows = []
+    base_vps = None
+    for c in counts:
+        sys.stderr.write(f"  mesh width {c}: compiling + timing...\n")
+        mgr = MeshManager(devices=list(jax.devices())[:c])
+        v = ShardedBatchVerifier(mesh=mgr, min_device_batch=1)
+        mask, tally = v.verify_batch_with_powers(triples, powers)  # warm
+        assert bool(mask.all()) and tally == 3 * batch, (int(mask.sum()), tally)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            mask, tally = v.verify_batch_with_powers(triples, powers)
+        dt = time.perf_counter() - t0
+        vps = batch * reps / dt
+        if base_vps is None:
+            base_vps = vps
+        rows.append(
+            {
+                "devices": c,
+                "verifies_per_s": round(vps, 1),
+                "commit_ms": round(dt / reps * 1e3, 3),
+                "scaling_efficiency": round(vps / (base_vps * c), 3),
+            }
+        )
+    return {
+        "batch": batch,
+        "reps": reps,
+        "backend": jax.default_backend(),
+        "virtual_devices": jax.default_backend() == "cpu",
+        "widths": rows,
+    }
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -559,6 +611,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip device backends even on TPU",
     )
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="run the sharded_verify mesh-scaling section (devices="
+        "1/2/4/8; pays one kernel compile per mesh width — minutes on "
+        "XLA:CPU, cached-fast on TPU)",
+    )
+    ap.add_argument(
+        "--mesh-batch",
+        type=int,
+        default=256,
+        dest="mesh_batch",
+        help="signatures per launch in the mesh-scaling section",
+    )
     args = ap.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
@@ -587,11 +653,13 @@ def main(argv=None) -> int:
     # per-backend verifies/s with small consensus-shaped batches
     verify_summaries = {
         b: s
-        for b in ("host", "device", "tables")
+        for b in ("host", "device", "tables", "mesh")
         if (s := backend_summary(b)) is not None
     }
     hash_summaries = {
-        b: s for b in ("host", "device") if (s := hash_summary(b)) is not None
+        b: s
+        for b in ("host", "device", "mesh")
+        if (s := hash_summary(b)) is not None
     }
     fastsync_pipeline = None
     if args.fastsync_blocks > 0:
@@ -620,6 +688,12 @@ def main(argv=None) -> int:
         coalesce_multiconsumer = drive_coalesce_multiconsumer(
             args.coalesce_rounds, args.coalesce_batch, args.launch_ms
         )
+    sharded_verify = None
+    if args.mesh:
+        sys.stderr.write(
+            f"driving mesh scaling, batch {args.mesh_batch} at widths 1/2/4/8...\n"
+        )
+        sharded_verify = drive_mesh_scaling(args.mesh_batch, args.reps)
 
     wal_count, wal_sum, wal_p50, wal_p99 = _histo("tendermint_wal_fsync_seconds")
     detail = {
@@ -631,6 +705,7 @@ def main(argv=None) -> int:
         "fastsync_pipeline": fastsync_pipeline,
         "dedup_steady_state": dedup_steady_state,
         "coalesce_multiconsumer": coalesce_multiconsumer,
+        "sharded_verify": sharded_verify,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
