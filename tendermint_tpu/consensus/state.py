@@ -55,6 +55,8 @@ from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vo
 from tendermint_tpu.types.vote_set import VoteSet
 from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.telemetry import tracectx as _trace
+from tendermint_tpu.telemetry.flightrec import FLIGHT
 from tendermint_tpu.utils.fail import fail_point
 from tendermint_tpu.utils import log as _log_mod
 import logging as _logging
@@ -183,19 +185,28 @@ class ConsensusState:
     def add_vote(self, vote: Vote, peer_id: str = "") -> None:
         if self.fatal_error is not None:
             return  # halted: nothing drains the queue anymore
-        self._queue.put(MsgRecord(vote, peer_id))
+        self._queue.put(self._record(vote, peer_id))
 
     def set_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
         if self.fatal_error is not None:
             return
-        self._queue.put(MsgRecord(proposal, peer_id))
+        self._queue.put(self._record(proposal, peer_id))
 
     def add_proposal_block_part(
         self, height: int, round_: int, part: Part, peer_id: str = ""
     ) -> None:
         if self.fatal_error is not None:
             return
-        self._queue.put(MsgRecord((height, round_, part), peer_id))
+        self._queue.put(self._record((height, round_, part), peer_id))
+
+    @staticmethod
+    def _record(msg, peer_id: str) -> MsgRecord:
+        """Stamp the enqueued input with the caller thread's ambient
+        trace context + arrival time (the p2p recv loop installs the
+        wire context before reactor dispatch) so the receive loop can
+        re-establish the context while processing — trace propagation
+        survives the thread hop through the queue."""
+        return MsgRecord(msg, peer_id, ctx=_trace.current(), arrived=time_mod.time())
 
     def get_round_state(self) -> RoundState:
         with self._mtx:
@@ -228,8 +239,9 @@ class ConsensusState:
     # A backlog of this many same-(height, round, type) votes switches the
     # loop to one batched device verify instead of per-vote singles
     # (SURVEY §7 hard part 3: a 10k-validator vote storm must not verify
-    # 10k sigs one at a time on host while the TPU idles).
-    VOTE_DRAIN_MIN = 8
+    # 10k sigs one at a time on host while the TPU idles). Env-tunable
+    # so small validator sets can opt into batched preverifies too.
+    VOTE_DRAIN_MIN = int(os.environ.get("TENDERMINT_TPU_VOTE_DRAIN_MIN", "8"))
     VOTE_DRAIN_MAX = 4096
     # Vote-batch preverifies kept in flight: while batch K's signatures
     # fly on device, the loop keeps pulling the queue and drains batch
@@ -260,9 +272,9 @@ class ConsensusState:
                 # shutting down: join in-flight preverifies so their
                 # dispatch slots release; the votes are WAL'd and replay
                 # on restart, no state is mutated past this point
-                for _recs, handle in pending:
+                for entry in pending:
                     try:
-                        handle.result()
+                        entry[1].result()
                     except Exception:
                         pass
                 return
@@ -325,13 +337,22 @@ class ConsensusState:
             except (ErrDoubleSign, FatalConsensusError) as e:
                 # Internal failure: halt consensus rather than keep voting
                 # from a half-advanced state (reference PanicConsensus —
-                # crash recovery takes over on restart).
+                # crash recovery takes over on restart). The flight
+                # recorder dumps its ring NOW: the events leading into
+                # the halt are exactly what the post-mortem needs.
                 import traceback
 
                 traceback.print_exc()
                 self.fatal_error = e
                 self._running = False
                 self.ticker.stop()
+                FLIGHT.record(
+                    "fatal",
+                    error=type(e).__name__,
+                    height=self.height,
+                    round=self.round,
+                )
+                FLIGHT.dump(reason="consensus-fatal")
                 raise
             except Exception:  # a bad peer message must not kill consensus
                 import traceback
@@ -361,7 +382,29 @@ class ConsensusState:
         """Pipeline stage 1: WAL the drained run (drain order == WAL
         order == eventual processing order), prep the signature triples
         under the state lock, and launch their batch verify through the
-        dispatch queue. No round state is mutated here."""
+        dispatch queue. No round state is mutated here.
+
+        Trace attribution: the launch runs with the height's block
+        context (the proposal's, which the proposer adopted from its
+        first traced tx) — or a drained vote's own context — ambient,
+        so the coalescer request and the device launch downstream join
+        the trace of the block being decided."""
+        submitted = time_mod.time()
+        exemplar = self._proposal_ctx
+        traced = [rec for rec in records if rec.ctx is not None]
+        if exemplar is None and traced:
+            exemplar = traced[0].ctx
+        for rec in traced:
+            if rec.arrived:
+                _metrics.VOTE_STAGE.labels(stage="drain").observe(
+                    submitted - rec.arrived, exemplar=rec.ctx.trace
+                )
+        FLIGHT.record(
+            "vote_batch",
+            n=len(records),
+            height=self.height,
+            round=self.round,
+        )
         with self._mtx:
             if self.wal is not None:
                 for rec in records:
@@ -369,10 +412,15 @@ class ConsensusState:
                         self.wal.save(rec)
                     except Exception as e:
                         raise FatalConsensusError("WAL write failed") from e
-            handle = self._preverify_votes_async([rec.msg for rec in records])
-        return records, handle
+            with _trace.use(exemplar):
+                handle = self._preverify_votes_async(
+                    [rec.msg for rec in records]
+                )
+        return records, handle, submitted, exemplar
 
-    def _join_vote_batch(self, records: list, handle) -> None:
+    def _join_vote_batch(
+        self, records: list, handle, submitted: float = 0.0, exemplar=None
+    ) -> None:
         """Pipeline stage 2: join the verdict mask, then tally each vote
         with the mask deciding which skip the in-set signature check
         (failed lanes re-verify individually so error attribution matches
@@ -385,16 +433,46 @@ class ConsensusState:
 
             traceback.print_exc()
             verdicts = [False] * len(records)
+        joined = time_mod.time()
+        if exemplar is not None and submitted:
+            _metrics.VOTE_STAGE.labels(stage="verify").observe(
+                joined - submitted, exemplar=exemplar.trace
+            )
         with self._mtx:
             for rec, ok in zip(records, verdicts):
                 try:
-                    self._handle_vote(rec.msg, rec.peer_id, preverified=bool(ok))
+                    with _trace.use(rec.ctx):
+                        self._handle_vote(
+                            rec.msg, rec.peer_id, preverified=bool(ok)
+                        )
+                    if rec.ctx is not None:
+                        self._observe_vote_e2e(rec, joined)
                 except (ErrDoubleSign, FatalConsensusError):
                     raise
                 except Exception:  # per-vote fault isolation, as singles
                     import traceback
 
                     traceback.print_exc()
+
+    def _observe_vote_e2e(self, rec, done: float) -> None:
+        """One traced vote's gossip-arrival → verdict-applied span +
+        e2e histogram slice (sampled votes only)."""
+        if not rec.arrived:
+            return
+        v = rec.msg
+        _metrics.VOTE_STAGE.labels(stage="e2e").observe(
+            done - rec.arrived, exemplar=rec.ctx.trace
+        )
+        TRACER.add(
+            "vote.e2e",
+            rec.arrived,
+            done,
+            trace=rec.ctx.trace,
+            origin=rec.ctx.origin,
+            height=v.height,
+            round=v.round,
+            type=v.type,
+        )
 
     def _vote_queue(self):
         if self._vote_dispatch is None:
@@ -456,13 +534,20 @@ class ConsensusState:
     def _dispatch(self, item) -> None:
         if isinstance(item, MsgRecord):
             m = item.msg
-            if isinstance(m, Vote):
-                self._handle_vote(m, item.peer_id)
-            elif isinstance(m, Proposal):
-                self.set_proposal_fn(m)
-            else:
-                height, round_, part = m
-                self._handle_block_part(height, round_, part)
+            # Re-establish the record's trace context for the whole
+            # handling scope: events fired from here (EVENT_VOTE /
+            # EVENT_COMPLETE_PROPOSAL push-gossip) re-attach it to
+            # outbound frames without any reactor plumbing.
+            with _trace.use(getattr(item, "ctx", None)):
+                if isinstance(m, Vote):
+                    self._handle_vote(m, item.peer_id)
+                    if item.ctx is not None:
+                        self._observe_vote_e2e(item, time_mod.time())
+                elif isinstance(m, Proposal):
+                    self.set_proposal_fn(m)
+                else:
+                    height, round_, part = m
+                    self._handle_block_part(height, round_, part)
         elif isinstance(item, TimeoutRecord):
             self._handle_timeout(
                 TimeoutInfo(item.duration, item.height, item.round, item.step)
@@ -542,6 +627,11 @@ class ConsensusState:
         self.last_commit = last_commit
         self._phase_name = None
         self._height_started = time_mod.monotonic()
+        # the height's block trace context: adopted from the proposal
+        # (proposer: its first traced tx; receivers: the proposal
+        # frame's context) — vote-batch verifies for this height are
+        # attributed to it
+        self._proposal_ctx = None
         _metrics.CONSENSUS_HEIGHT.set(self.height)
         _metrics.CONSENSUS_ROUND.set(0)
 
@@ -652,6 +742,12 @@ class ConsensusState:
     def _new_step(self) -> None:
         if self.wal is not None:
             self.wal.save(RoundStateRecord(self.height, self.round, self.step))
+        FLIGHT.record(
+            "round_step",
+            height=self.height,
+            round=self.round,
+            step=RoundStepType.name(self.step),
+        )
         self.event_switch.fire(ev.EVENT_NEW_ROUND_STEP, self._rs_event())
 
     def _enter_new_round(self, height: int, round_: int) -> None:
@@ -774,10 +870,32 @@ class ConsensusState:
             proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
         except ErrDoubleSign:
             return
+        # Proposal creation is a trace edge: adopt the first traced
+        # tx's context as the BLOCK's context (the verify work this
+        # height does is causally that tx's), minting fresh otherwise.
+        # The internal sends below run with it ambient, so the records
+        # — and the push-gossiped proposal/parts frames they trigger —
+        # carry it to every peer.
+        ctx = None
+        trace_for = getattr(self.mempool, "trace_for", None)
+        if trace_for is not None:
+            for tx in block.data.txs:
+                ctx = trace_for(bytes(tx))
+                if ctx is not None:
+                    break
+        if ctx is None:
+            origin = (
+                self.priv_validator.address.hex()[:12]
+                if self.priv_validator is not None
+                else ""
+            )
+            ctx = _trace.mint(origin)
+        self._proposal_ctx = ctx
         # send to ourselves (internal queue, no peer id)
-        self.set_proposal(proposal, "")
-        for i in range(parts.total):
-            self.add_proposal_block_part(height, round_, parts.get_part(i), "")
+        with _trace.use(ctx):
+            self.set_proposal(proposal, "")
+            for i in range(parts.total):
+                self.add_proposal_block_part(height, round_, parts.get_part(i), "")
 
     def _create_proposal_block(self) -> tuple[Block, PartSet] | None:
         """Reference `createProposalBlock :848-868`."""
@@ -818,6 +936,10 @@ class ConsensusState:
         ):
             raise ValidationError("invalid proposal signature")
         self.proposal = proposal
+        if self._proposal_ctx is None:
+            # receiver side of block-context adoption: the proposal
+            # frame's trace context is ambient here (via the record)
+            self._proposal_ctx = _trace.current()
         if self.proposal_block_parts is None:
             self.proposal_block_parts = PartSet.from_header(proposal.block_parts_header)
 
@@ -825,6 +947,10 @@ class ConsensusState:
         """Reference `addProposalBlockPart :1282-1315`."""
         if height != self.height or self.proposal_block_parts is None:
             return
+        if self._proposal_ctx is None:
+            # block parts carry the block's context too (push gossip);
+            # adopt when the proposal itself arrived uncontexted
+            self._proposal_ctx = _trace.current()
         try:
             added = self.proposal_block_parts.add_part(part)
         except ValidationError:
@@ -1090,6 +1216,33 @@ class ConsensusState:
                 round=self.commit_round,
                 txs=len(block.data.txs),
             )
+            FLIGHT.record(
+                "commit",
+                height=height,
+                round=self.commit_round,
+                txs=len(block.data.txs),
+                hash=block.hash().hex()[:12],
+            )
+            # close every committed traced tx: first-seen -> committed
+            # on THIS node's clock, linked back by exemplar trace id
+            take_trace = getattr(self.mempool, "take_trace", None)
+            if take_trace is not None:
+                for tx in block.data.txs:
+                    entry = take_trace(bytes(tx))
+                    if entry is None:
+                        continue
+                    tx_ctx, t_seen = entry
+                    _metrics.TX_E2E.observe(
+                        wall_end - t_seen, exemplar=tx_ctx.trace
+                    )
+                    TRACER.add(
+                        "tx.e2e",
+                        t_seen,
+                        wall_end,
+                        trace=tx_ctx.trace,
+                        origin=tx_ctx.origin,
+                        height=height,
+                    )
             self._update_to_state(state_copy)
         except FatalConsensusError:
             raise
@@ -1231,4 +1384,15 @@ class ConsensusState:
         # round state and corrupts it (observed as a fatal "enterCommit
         # without +2/3 precommits" under multi-node gossip load). The
         # queue item is WAL'd by the receive loop like any other input.
-        self._queue.put(MsgRecord(vote, ""))
+        # Vote creation is a trace edge: a vote cast FOR this height's
+        # block is causally part of that block's trace (which the
+        # proposer adopted from its first traced tx), so it re-hops the
+        # block context — the whole decision path of a traced tx shares
+        # one trace_id, node to node. Votes before any block context
+        # exists (nil prevotes, early rounds) mint their own,
+        # head-sampled.
+        if self._proposal_ctx is not None:
+            ctx = self._proposal_ctx.rehop()
+        else:
+            ctx = _trace.mint(self.priv_validator.address.hex()[:12])
+        self._queue.put(MsgRecord(vote, "", ctx=ctx, arrived=time_mod.time()))

@@ -17,7 +17,7 @@ import os
 import struct
 import time
 import zlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Iterator
 
 from tendermint_tpu.codec import Reader, Writer
@@ -59,10 +59,17 @@ class RoundStateRecord:
 
 @dataclass(frozen=True)
 class MsgRecord:
-    """A consensus input: vote/proposal/block-part + its origin peer."""
+    """A consensus input: vote/proposal/block-part + its origin peer.
+
+    `ctx`/`arrived` are IN-MEMORY tracing metadata (the trace context
+    ambient when the input was enqueued + its wall-clock arrival) —
+    deliberately not WAL-encoded and excluded from equality: replayed
+    records are the same consensus input with or without a trace."""
 
     msg: object  # Vote | Proposal | (height, round, Part)
     peer_id: str
+    ctx: object = field(default=None, compare=False, repr=False)
+    arrived: float = field(default=0.0, compare=False, repr=False)
 
 
 def _encode_record(item) -> bytes:

@@ -13,16 +13,24 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable
 
 from tendermint_tpu.abci.client import AppConnMempool
 from tendermint_tpu.abci.types import CodeType, Result
+from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
-from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.telemetry import tracectx as _trace
+from tendermint_tpu.types.tx import Tx, Txs, tx_hash
 
 DEFAULT_CACHE_SIZE = 100_000
+
+# Bounded tx-hash -> (TraceContext, first_seen) table: big enough for
+# several full blocks of in-flight traced txs, small enough that an
+# abandoned tx can't pin memory. Evictions count as dropped traces.
+TRACE_TABLE_SIZE = 4096
 
 
 class TxCache:
@@ -69,6 +77,7 @@ class Mempool:
         cache_size: int = DEFAULT_CACHE_SIZE,
         wal_dir: str | None = None,
         recheck: bool = True,
+        node_id: str = "",
     ) -> None:
         self._app = app_conn
         self._txs: list[MempoolTx] = []
@@ -80,6 +89,11 @@ class Mempool:
         self._recheck = recheck
         self._notified_available = False
         self._fire_available: Callable[[], None] | None = None
+        # distributed tracing: who minted (span attr `node`) + the
+        # tx-hash -> (ctx, first_seen) table the gossip reactor and the
+        # commit-time tx.e2e observation read
+        self._node_id = node_id
+        self._traces: "OrderedDict[bytes, tuple[object, float]]" = OrderedDict()
         self._wal = None
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
@@ -102,6 +116,7 @@ class Mempool:
         with self._lock:
             self._txs.clear()
             self._cache.reset()
+            self._traces.clear()
             _metrics.MEMPOOL_SIZE.set(0)
 
     def check_tx(self, tx: Tx, cb: Callable[[Result], None] | None = None) -> Result:
@@ -122,6 +137,14 @@ class Mempool:
             if cb is not None:
                 cb(res)
             return res
+        # Admission is a trace edge: a gossiped tx arrives with the
+        # sender's context ambient on this thread (set by the p2p recv
+        # loop); a locally-submitted one (RPC broadcast) mints here —
+        # head-based sampling, so most txs pay one thread-local read.
+        t_admit = time.time()
+        ctx = _trace.current()
+        if ctx is None:
+            ctx = _trace.mint(self._node_id)
         if self._wal is not None:
             # length-framed (txs are arbitrary bytes); buffered+flushed but
             # NOT fsync'd per tx — the mempool WAL is best-effort, unlike
@@ -138,14 +161,46 @@ class Mempool:
                 _metrics.MEMPOOL_SIZE.set(len(self._txs))
                 self._notify_txs_available()
                 self._txs_available.notify_all()
+                if ctx is not None:
+                    self._traces[tx_hash(tx)] = (ctx, t_admit)
+                    while len(self._traces) > TRACE_TABLE_SIZE:
+                        self._traces.popitem(last=False)
+                        _metrics.TRACE_DROPPED.inc()
             _metrics.MEMPOOL_TXS.labels(result="ok").inc()
         else:
             # bad tx: evict from cache so a corrected app state can re-admit
             self._cache.remove(tx)
             _metrics.MEMPOOL_TXS.labels(result="rejected").inc()
+        if ctx is not None:
+            TRACER.add(
+                "mempool.admission",
+                t_admit,
+                time.time(),
+                trace=ctx.trace,
+                node=self._node_id,
+                tx=tx_hash(tx).hex()[:16],
+                result="ok" if res.is_ok else "rejected",
+            )
         if cb is not None:
             cb(res)
         return res
+
+    # -- distributed tracing -------------------------------------------------
+
+    def trace_for(self, tx: bytes):
+        """The TraceContext admitted with `tx` (None when unsampled or
+        unknown) — the gossip reactor re-attaches it on the wire and
+        the proposer adopts it as the block's context."""
+        with self._lock:
+            entry = self._traces.get(tx_hash(bytes(tx)))
+        return entry[0] if entry is not None else None
+
+    def take_trace(self, tx: bytes):
+        """Pop `tx`'s (ctx, first_seen) entry — consumed exactly once,
+        at commit, for the `tendermint_tx_e2e_seconds` observation and
+        the tx.e2e span."""
+        with self._lock:
+            return self._traces.pop(tx_hash(bytes(tx)), None)
 
     def reap(self, max_txs: int) -> Txs:
         """Up to max_txs txs for a proposal (-1 = all), pool unchanged

@@ -73,11 +73,15 @@ class MempoolReactor(Reactor):
     def _broadcast_routine(self, peer: Peer) -> None:
         """Reference `broadcastTxRoutine :114-152`. The cursor is the
         mempool's intake counter (commit-time compaction renumbers list
-        positions but never counters)."""
+        positions but never counters). A traced tx's admission context
+        rides the frame, so the receiving node's CheckTx joins the same
+        trace."""
         cursor = 0
+        trace_for = getattr(self.mempool, "trace_for", None)
         while self._running and peer.get(self.PEER_KEY):
             for counter, tx in self.mempool.get_after(
                 cursor, wait=True, timeout=0.2
             ):
-                peer.send(MEMPOOL_CHANNEL, encode_tx_message(tx))
+                ctx = trace_for(tx) if trace_for is not None else None
+                peer.send(MEMPOOL_CHANNEL, encode_tx_message(tx), ctx=ctx)
                 cursor = max(cursor, counter)

@@ -100,6 +100,13 @@ class Node:
         self._span_log = persist_spans(
             TRACER, os.path.join(cfg.home, cfg.base.db_dir, "spans.jsonl")
         )
+        # flight recorder: black-box dumps land next to the span log;
+        # SIGUSR2 snapshots a live node (no-op off the main thread)
+        from tendermint_tpu.telemetry.flightrec import FLIGHT, install_signal_dump
+
+        FLIGHT.set_node_id(self.node_id)
+        FLIGHT.set_dump_dir(os.path.join(cfg.home, cfg.base.db_dir))
+        install_signal_dump()
 
         # state + stores
         self.state_db = _db("state")
@@ -133,6 +140,7 @@ class Node:
             cache_size=cfg.mempool.cache_size,
             wal_dir=cfg.mempool_wal_path() if cfg.mempool.wal_dir else None,
             recheck=cfg.mempool.recheck,
+            node_id=self.node_id,
         )
         # re-validate txs that were in flight before a crash; the WAL is
         # compacted to the survivors so it cannot grow across restarts

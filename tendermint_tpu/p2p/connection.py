@@ -8,7 +8,14 @@ weighted by priority; a recv thread parses frames and hands
 (chan_id, payload) to the owner's on_receive callback.
 
 Frame format (TCP-ready, though the in-memory pipe preserves framing
-anyway): uvarint chan_id || uvarint len || payload.
+anyway): uvarint chan_id || uvarint len || payload [|| trace block].
+
+The trace block is OPTIONAL and trailing — `telemetry/tracectx.py`'s
+TraceContext wire encoding. Codec-backward-compatible by construction:
+a frame without it is byte-identical to the old format and decodes
+unchanged; a receiver that doesn't know the block ignores trailing
+bytes; a decode failure drops the context (counted), never the frame.
+Sampled-out messages carry no context bytes at all.
 """
 
 from __future__ import annotations
@@ -20,8 +27,35 @@ from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
 from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
+from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.telemetry import tracectx as _trace
+from tendermint_tpu.telemetry.tracectx import TraceContext
 from tendermint_tpu.utils.flowrate import Monitor
+
+
+def build_frame(chan_id: int, payload: bytes, ctx: TraceContext | None = None) -> bytes:
+    """One wire frame; `ctx=None` produces the exact legacy bytes."""
+    w = Writer().uvarint(chan_id).bytes(payload)
+    if ctx is not None:
+        w.raw(ctx.encode_wire())
+    return w.build()
+
+
+def parse_frame(frame: bytes) -> tuple[int, bytes, TraceContext | None]:
+    """(chan_id, payload, trace context or None). Absent or
+    undecodable trailing block ⇒ no context (the frame itself always
+    survives — tracing is forensic, never load-bearing)."""
+    r = Reader(frame)
+    chan_id = r.uvarint()
+    payload = r.bytes()
+    ctx = None
+    if not r.done():
+        try:
+            ctx = TraceContext.decode_wire(r)
+        except Exception:
+            _metrics.TRACE_DROPPED.inc()
+    return chan_id, payload, ctx
 
 # Internal keepalive channel (reference sends dedicated packetTypePing/
 # packetTypePong frames, `p2p/connection.go:312-345`; here they ride a
@@ -46,7 +80,8 @@ class ChannelDescriptor:
 class _Channel:
     def __init__(self, desc: ChannelDescriptor) -> None:
         self.desc = desc
-        self.queue: "queue.Queue[bytes]" = queue.Queue(
+        # (payload, trace context or None) pairs
+        self.queue: "queue.Queue[tuple[bytes, TraceContext | None]]" = queue.Queue(
             maxsize=desc.send_queue_capacity
         )
         self.recently_sent = 0
@@ -70,7 +105,11 @@ class MConnection:
         recv_limit: int = 0,
         ping_interval: float = DEFAULT_PING_INTERVAL,
         pong_timeout: float = DEFAULT_PONG_TIMEOUT,
+        local_node_id: str = "",
     ) -> None:
+        # who WE are, for `p2p.hop` span attribution (the Switch wires
+        # its node id through Peer; "" on bare test connections)
+        self.local_node_id = local_node_id
         # per-connection throughput stats + optional rate caps
         # (reference flowrate.Monitor at p2p/connection.go:72-73)
         self.send_monitor = Monitor(send_limit)
@@ -118,31 +157,46 @@ class MConnection:
 
     # -- sending -----------------------------------------------------------
 
-    def send(self, chan_id: int, payload: bytes, timeout: float = 5.0) -> bool:
+    def send(
+        self,
+        chan_id: int,
+        payload: bytes,
+        timeout: float = 5.0,
+        ctx: TraceContext | None = None,
+    ) -> bool:
         """Queue for send; blocks up to timeout on a full channel queue
         (reference `Send` blocks, `TrySend` doesn't). Sends BEFORE
         start() queue up and flush once the send loop runs — reactors
-        greet a new peer (add_peer step messages) before it starts."""
+        greet a new peer (add_peer step messages) before it starts.
+        `ctx` (or, when None, the calling thread's ambient trace
+        context) is captured NOW and framed with the payload — the send
+        loop runs on its own thread."""
         ch = self._channels.get(chan_id)
         if ch is None:
             raise ValueError(f"unknown channel {chan_id:#x}")
         if self._stopped:
             return False
+        if ctx is None:
+            ctx = _trace.current()
         try:
-            ch.queue.put(payload, timeout=timeout)
+            ch.queue.put((payload, ctx), timeout=timeout)
         except queue.Full:
             return False
         self._send_wake.set()
         return True
 
-    def try_send(self, chan_id: int, payload: bytes) -> bool:
+    def try_send(
+        self, chan_id: int, payload: bytes, ctx: TraceContext | None = None
+    ) -> bool:
         ch = self._channels.get(chan_id)
         if ch is None:
             raise ValueError(f"unknown channel {chan_id:#x}")
         if self._stopped:
             return False
+        if ctx is None:
+            ctx = _trace.current()
         try:
-            ch.queue.put_nowait(payload)
+            ch.queue.put_nowait((payload, ctx))
         except queue.Full:
             return False
         self._send_wake.set()
@@ -180,12 +234,12 @@ class MConnection:
                     self._send_wake.clear()
                     continue
                 try:
-                    payload = ch.queue.get_nowait()
+                    payload, ctx = ch.queue.get_nowait()
                 except queue.Empty:
                     continue
-                frame = (
-                    Writer().uvarint(ch.desc.id).bytes(payload).build()
-                )
+                frame = build_frame(ch.desc.id, payload, ctx)
+                if ctx is not None:
+                    _metrics.TRACE_PROPAGATED.inc()
                 self.send_monitor.throttle()
                 self._endpoint.send(frame)
                 self.send_monitor.update(len(frame))
@@ -209,9 +263,7 @@ class MConnection:
                 # inbound flow control: delay further reads once over
                 # the cap (the sender blocks on TCP backpressure)
                 self.recv_monitor.throttle()
-                r = Reader(frame)
-                chan_id = r.uvarint()
-                payload = r.bytes()
+                chan_id, payload, ctx = parse_frame(frame)
                 self._last_recv = time.monotonic()
                 if chan_id == CTRL_CHANNEL:
                     # keepalive (reference recvRoutine ping/pong handling
@@ -219,14 +271,32 @@ class MConnection:
                     # already refreshed _last_recv above
                     if payload == _PING:
                         try:
-                            self._ctrl.queue.put_nowait(_PONG)
+                            self._ctrl.queue.put_nowait((_PONG, None))
                             self._send_wake.set()
                         except queue.Full:
                             pass  # a pong is already queued
                     continue
                 if chan_id not in self._channels:
                     continue  # unknown channel: drop (fuzz/future-proof)
-                self._on_receive(chan_id, payload)
+                if ctx is None:
+                    self._on_receive(chan_id, payload)
+                else:
+                    # one hop span per traced frame, then process with
+                    # the re-parented context ambient so reactor →
+                    # mempool/consensus work (and any gossip-out it
+                    # triggers) stays on this trace
+                    now = time.time()
+                    TRACER.add(
+                        "p2p.hop",
+                        now,
+                        now,
+                        trace=ctx.trace,
+                        origin=ctx.origin,
+                        node=self.local_node_id,
+                        chan=chan_id,
+                    )
+                    with _trace.use(ctx.rehop()):
+                        self._on_receive(chan_id, payload)
         except EndpointClosed:
             self._die(None)
         except Exception as e:
@@ -252,7 +322,7 @@ class MConnection:
             if idle > self.ping_interval and now - last_ping > self.ping_interval:
                 last_ping = now
                 try:
-                    self._ctrl.queue.put_nowait(_PING)
+                    self._ctrl.queue.put_nowait((_PING, None))
                     self._send_wake.set()
                 except queue.Full:
                     pass  # a ping is already in flight

@@ -82,6 +82,7 @@ class Peer:
         recv_limit: int = 0,
         ping_interval: float | None = None,
         pong_timeout: float | None = None,
+        local_node_id: str = "",
     ) -> None:
         self.node_info = node_info
         self.outbound = outbound
@@ -98,6 +99,7 @@ class Peer:
             lambda exc: on_error(self, exc),
             send_limit=send_limit,
             recv_limit=recv_limit,
+            local_node_id=local_node_id,
             **kw,
         )
 
@@ -127,11 +129,13 @@ class Peer:
     def stop(self) -> None:
         self._conn.stop()
 
-    def send(self, chan_id: int, payload: bytes) -> bool:
-        return self._conn.send(chan_id, payload)
+    def send(self, chan_id: int, payload: bytes, ctx=None) -> bool:
+        """`ctx` (a `telemetry.tracectx.TraceContext`) rides the frame;
+        None falls back to the calling thread's ambient context."""
+        return self._conn.send(chan_id, payload, ctx=ctx)
 
-    def try_send(self, chan_id: int, payload: bytes) -> bool:
-        return self._conn.try_send(chan_id, payload)
+    def try_send(self, chan_id: int, payload: bytes, ctx=None) -> bool:
+        return self._conn.try_send(chan_id, payload, ctx=ctx)
 
     def get(self, key: str, default=None):
         return self.data.get(key, default)

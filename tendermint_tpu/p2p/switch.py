@@ -189,6 +189,7 @@ class Switch:
                 recv_limit=self.recv_rate,
                 ping_interval=self.ping_interval,
                 pong_timeout=self.pong_timeout,
+                local_node_id=self._base_info.node_id,
             )
             self._peers[remote_info.node_id] = peer
         # Reactors install their per-peer state BEFORE the recv loop

@@ -319,6 +319,9 @@ class MeshManager:
         from tendermint_tpu.telemetry import metrics as _metrics
         from tendermint_tpu.utils.log import kv, logger
 
+        from tendermint_tpu.telemetry import tracectx as _tracectx
+        from tendermint_tpu.telemetry.flightrec import FLIGHT
+
         with self._lock:
             _metrics.MESH_SHARD_FAULTS.inc()
             self._last_fault = time.monotonic()
@@ -329,6 +332,10 @@ class MeshManager:
             if survivors > 0:
                 _metrics.MESH_REMESH.labels(direction="shrink").inc()
             self._bind_gauge()
+        # a mesh transition is a forensic moment: black-box it and
+        # sample everything for a window (same policy as breaker trips)
+        FLIGHT.record("mesh", event="shard_fault", shard=shard, survivors=survivors)
+        _tracectx.boost()
         kv(
             logger("mesh"),
             logging.WARNING,
@@ -363,6 +370,11 @@ class MeshManager:
             self._excluded -= recovered
             _metrics.MESH_REMESH.labels(direction="restore").inc()
             self._bind_gauge()
+        from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+        FLIGHT.record(
+            "mesh", event="restore", recovered=sorted(recovered), active=self.n_active
+        )
 
     def reset(self) -> None:
         """Forget all exclusions (tests)."""

@@ -231,11 +231,18 @@ def make_routes(node) -> dict:
             "peers": peers,
         }
 
-    def dump_telemetry(spans: int = 128, prefix: str = "") -> dict:
+    def dump_telemetry(
+        spans: int = 128, prefix: str = "", trace_id: str = "", flight: int = 0
+    ) -> dict:
         """Structured telemetry dump: the full metrics registry, the
         recent span window (consensus round phases, device dispatch),
         and per-service breaker snapshots. The JSON twin of
-        `GET /metrics` (docs/OBSERVABILITY.md)."""
+        `GET /metrics` (docs/OBSERVABILITY.md).
+
+        `trace_id` (hex) narrows the span window to one distributed
+        trace — the live-node half of `tools/trace_timeline.py`;
+        `flight` > 0 additionally returns that many recent flight-
+        recorder events."""
         from tendermint_tpu.telemetry import REGISTRY, TRACER
 
         breakers = {}
@@ -248,14 +255,29 @@ def make_routes(node) -> dict:
                     breakers[name] = svc.snapshot()
                 except Exception:
                     pass
-        return {
+        if trace_id:
+            # trace filter ignores the recency cap: a stitched timeline
+            # wants every matching span still in the ring
+            span_window = [
+                s
+                for s in TRACER.recent(prefix=str(prefix))
+                if (s.get("attrs") or {}).get("trace") == str(trace_id)
+            ]
+        else:
+            span_window = TRACER.recent(n=int(spans), prefix=str(prefix))
+        out = {
             "metrics": REGISTRY.to_dict(),
-            "spans": TRACER.recent(n=int(spans), prefix=str(prefix)),
+            "spans": span_window,
             "breakers": breakers,
             # per-peer view the exported gauges deliberately aggregate
             # (peer-id label cardinality — docs/OBSERVABILITY.md)
             "p2p": {"send_queues": node.switch.send_queue_depths()},
         }
+        if int(flight) > 0:
+            from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+            out["flight"] = FLIGHT.recent(n=int(flight))
+        return out
 
     def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
         res = node.app_conns.query.query_sync(

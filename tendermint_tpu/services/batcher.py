@@ -53,7 +53,10 @@ from typing import Callable, Sequence
 import numpy as np
 
 from tendermint_tpu.services.verifier import BatchVerifier, Triple
+from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.telemetry import tracectx as _trace
+from tendermint_tpu.telemetry.flightrec import FLIGHT
 
 CACHE_SIZE = int(os.environ.get("TENDERMINT_TPU_VERIFY_CACHE_SIZE", "65536"))
 MAX_COALESCED_BATCH = int(
@@ -185,9 +188,10 @@ class _Request:
         "error",
         "submitted_at",
         "flushed",
+        "ctx",
     )
 
-    def __init__(self, consumer, out, novel, novel_pos, novel_keys):
+    def __init__(self, consumer, out, novel, novel_pos, novel_keys, ctx=None):
         self.consumer = consumer
         self.out = out
         self.novel = novel
@@ -197,6 +201,9 @@ class _Request:
         self.error: BaseException | None = None
         self.submitted_at = time.perf_counter()
         self.flushed = False
+        # the submitter thread's ambient trace context, captured at
+        # submit — the flusher runs on its own thread
+        self.ctx = ctx
 
 
 class SubHandle:
@@ -362,7 +369,9 @@ class VerifyCoalescer:
             novel.append((pk, msg, sig))
             novel_pos.append(i)
             novel_keys.append(key)
-        req = _Request(consumer, out, novel, novel_pos, novel_keys)
+        req = _Request(
+            consumer, out, novel, novel_pos, novel_keys, ctx=_trace.current()
+        )
         if not novel:
             req.flushed = True
             req.event.set()
@@ -463,16 +472,40 @@ class VerifyCoalescer:
         merged: list[Triple] = []
         for req in batch:
             merged.extend(req.novel)
+        # trace attribution: one exemplar context per merged launch (the
+        # oldest traced request's) — the flush span links the aggregate
+        # back to a concrete traced message, and launching with it
+        # ambient carries it into the dispatch-queue handle
+        exemplar = next((r.ctx for r in batch if r.ctx is not None), None)
+        FLIGHT.record(
+            "coalescer_flush",
+            reason=reason,
+            requests=len(batch),
+            triples=len(merged),
+        )
+        if exemplar is not None:
+            wall_now = time.time()
+            oldest = min(req.submitted_at for req in batch)
+            TRACER.add(
+                "batcher.flush",
+                wall_now - (now - oldest),
+                wall_now,
+                trace=exemplar.trace,
+                reason=reason,
+                requests=len(batch),
+                triples=len(merged),
+            )
         try:
-            if hasattr(self._verifier, "verify_batch_async"):
-                handle = self._verifier.verify_batch_async(
-                    merged, queue=self._queue
-                )
-            else:
-                handle = self._queue.submit(
-                    lambda m=merged: self._verifier.verify_batch(m),
-                    kind="verify",
-                )
+            with _trace.use(exemplar):
+                if hasattr(self._verifier, "verify_batch_async"):
+                    handle = self._verifier.verify_batch_async(
+                        merged, queue=self._queue
+                    )
+                else:
+                    handle = self._queue.submit(
+                        lambda m=merged: self._verifier.verify_batch(m),
+                        kind="verify",
+                    )
         except BaseException as e:  # dispatch-layer failure: fail the batch
             for req in batch:
                 req.error = e

@@ -41,7 +41,10 @@ import threading
 import time
 from typing import Callable
 
+from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.telemetry import tracectx as _trace
+from tendermint_tpu.telemetry.flightrec import FLIGHT
 
 # In-flight launches per queue (submitted, not yet joined). 2 is the
 # classic double-buffer: one launch on device, one window of host prep.
@@ -80,6 +83,8 @@ class VerifyHandle:
         "_lock",
         "_submitted_at",
         "_launched_at",
+        "_ctx",
+        "_submitted_wall",
     )
 
     def __init__(self, queue: "DispatchQueue", launch_fn, finalize_fn, kind: str):
@@ -96,6 +101,10 @@ class VerifyHandle:
         self._lock = threading.Lock()
         self._submitted_at = time.perf_counter()
         self._launched_at: float | None = None
+        # trace context ambient on the SUBMITTING thread — the worker
+        # records a `dispatch.launch` span against it (sampled only)
+        self._ctx = _trace.current()
+        self._submitted_wall = time.time() if self._ctx is not None else 0.0
 
     # -- worker side -------------------------------------------------------
 
@@ -105,11 +114,29 @@ class VerifyHandle:
             self._launched_at - self._submitted_at
         )
         try:
-            self._launched = self._launch_fn()
+            with _trace.use(self._ctx):
+                self._launched = self._launch_fn()
         except BaseException as e:  # delivered at result(), never lost
             self._launch_exc = e
         finally:
             self._launch_fn = None  # drop closed-over prep data promptly
+            FLIGHT.record(
+                "dispatch_launch",
+                queue=self._queue.name,
+                work=self.kind,
+                error=type(self._launch_exc).__name__
+                if self._launch_exc is not None
+                else "",
+            )
+            if self._ctx is not None:
+                TRACER.add(
+                    "dispatch.launch",
+                    self._submitted_wall,
+                    time.time(),
+                    trace=self._ctx.trace,
+                    queue=self._queue.name,
+                    kind=self.kind,
+                )
             self._event.set()
 
     # -- consumer side -----------------------------------------------------

@@ -25,12 +25,15 @@ from tendermint_tpu.telemetry.tracer import TRACER, Span, Tracer
 
 __all__ = [
     "Counter",
+    "FLIGHT",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
     "REGISTRY",
     "Span",
     "SpanLog",
+    "TraceContext",
     "Tracer",
     "TRACER",
     "LATENCY_BUCKETS",
@@ -40,10 +43,18 @@ __all__ = [
 
 
 def __getattr__(name: str):
-    # spanlog lazily: it is the one module here that touches the
-    # filesystem, and most importers only want the registry/tracer
+    # spanlog/flightrec/tracectx lazily: they touch the filesystem or
+    # os.urandom, and most importers only want the registry/tracer
     if name in ("SpanLog", "persist_spans"):
         from tendermint_tpu.telemetry import spanlog
 
         return getattr(spanlog, name)
+    if name in ("FLIGHT", "FlightRecorder"):
+        from tendermint_tpu.telemetry import flightrec
+
+        return getattr(flightrec, name)
+    if name == "TraceContext":
+        from tendermint_tpu.telemetry import tracectx
+
+        return tracectx.TraceContext
     raise AttributeError(name)

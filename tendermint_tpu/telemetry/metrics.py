@@ -206,6 +206,59 @@ BATCHER_WAIT = Histogram(
     buckets=LATENCY_BUCKETS,
 )
 
+# -- distributed tracing (telemetry/tracectx.py, tools/trace_timeline.py) -----
+#
+# `stage` is a fixed per-vote lifecycle slice: "drain" (gossip arrival
+# -> drained into a batch), "verify" (batch submit -> verdict join),
+# "e2e" (arrival -> verdict applied). Histograms carry exemplar trace
+# ids (JSON dump only) so an aggregate links back to one traced message.
+
+TX_E2E = Histogram(
+    "tendermint_tx_e2e_seconds",
+    "Tx first-seen (CheckTx admission) to committed in a finalized block",
+    buckets=LATENCY_BUCKETS,
+)
+VOTE_STAGE = Histogram(
+    "tendermint_vote_stage_seconds",
+    "Traced-vote lifecycle slices (drain/verify/e2e) on this node",
+    labelnames=("stage",),
+    buckets=LATENCY_BUCKETS,
+)
+TRACE_SAMPLED = Counter(
+    "tendermint_trace_sampled_total",
+    "Trace contexts minted (head-based sampling said yes)",
+)
+TRACE_PROPAGATED = Counter(
+    "tendermint_trace_propagated_total",
+    "p2p frames sent carrying a trace context",
+)
+TRACE_DROPPED = Counter(
+    "tendermint_trace_dropped_total",
+    "Trace contexts lost (wire decode failures, trace-table evictions)",
+)
+
+# The span-name catalog: every literal passed to TRACER.span()/.add()
+# in the package must appear here (collection-time lint in
+# tests/conftest.py, same discipline as the tendermint_* metric lint) —
+# an uncataloged span name means a timeline query that silently matches
+# nothing. The consensus round phases are recorded via an f-string over
+# the fixed phase set; they are cataloged for the tooling regardless.
+SPAN_CATALOG = frozenset(
+    {
+        "consensus.propose",
+        "consensus.prevote",
+        "consensus.precommit",
+        "consensus.commit",
+        "consensus.height",
+        "mempool.admission",
+        "p2p.hop",
+        "batcher.flush",
+        "dispatch.launch",
+        "tx.e2e",
+        "vote.e2e",
+    }
+)
+
 # Pre-seed the known breaker kinds, round-skip phases, and flush reasons
 # so scrapes see zero-valued series before (or without) any
 # instance/event — Prometheus convention: known label values start at 0,
@@ -218,6 +271,8 @@ for _reason in ("window", "size", "barrier"):
     BATCHER_FLUSH.labels(reason=_reason).inc(0)
 for _direction in ("shrink", "restore"):
     MESH_REMESH.labels(direction=_direction).inc(0)
+for _stage in ("drain", "verify", "e2e"):
+    VOTE_STAGE.labels(stage=_stage)
 
 # -- state sync ---------------------------------------------------------------
 

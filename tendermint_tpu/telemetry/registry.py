@@ -225,11 +225,17 @@ class _HistogramChild:
         self._counts = [0] * (len(buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        # last exemplar attached to an observation (a trace id): the
+        # breadcrumb from an aggregate back to one concrete traced
+        # request. JSON dump only — text format 0.0.4 has no exemplars.
+        self._exemplar: str | None = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         with self._lock:
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                self._exemplar = str(exemplar)
             for i, ub in enumerate(self.buckets):
                 if v <= ub:
                     self._counts[i] += 1
@@ -243,11 +249,14 @@ class _HistogramChild:
         for c in self._counts:
             running += c
             cumulative.append(running)
-        return {
+        out = {
             "buckets": list(zip(list(self.buckets) + [math.inf], cumulative)),
             "sum": self._sum,
             "count": self._count,
         }
+        if self._exemplar is not None:
+            out["exemplar"] = self._exemplar
+        return out
 
     @property
     def value(self) -> dict:
@@ -296,8 +305,8 @@ class Histogram(_Metric):
         self._children[values] = child
         return child
 
-    def observe(self, v: float) -> None:
-        self._child0().observe(v)
+    def observe(self, v: float, exemplar: str | None = None) -> None:
+        self._child0().observe(v, exemplar=exemplar)
 
     def quantile(self, q: float) -> float:
         return self._child0().quantile(q)
@@ -362,17 +371,18 @@ class Registry:
             for values, snap in m.samples():
                 labels = dict(zip(m.labelnames, values))
                 if m.type_name == "histogram":
-                    series.append(
-                        {
-                            "labels": labels,
-                            "sum": snap["sum"],
-                            "count": snap["count"],
-                            "buckets": [
-                                ["+Inf" if ub == math.inf else ub, cum]
-                                for ub, cum in snap["buckets"]
-                            ],
-                        }
-                    )
+                    entry = {
+                        "labels": labels,
+                        "sum": snap["sum"],
+                        "count": snap["count"],
+                        "buckets": [
+                            ["+Inf" if ub == math.inf else ub, cum]
+                            for ub, cum in snap["buckets"]
+                        ],
+                    }
+                    if "exemplar" in snap:
+                        entry["exemplar"] = snap["exemplar"]
+                    series.append(entry)
                 else:
                     series.append({"labels": labels, "value": snap})
             out[m.name] = {

@@ -106,8 +106,11 @@ def persist_spans(
 ) -> SpanLog:
     """Boot-time wiring: replay the persisted window into `tracer`
     (attr `restored: true` marks pre-restart spans in `dump_telemetry`)
-    and THEN install the log as the tracer's sink — replay must not
-    re-append what the file already holds."""
+    and THEN install the log as one of the tracer's sinks — replay must
+    not re-append what the file already holds. Sinks are additive
+    (`Tracer.add_sink`) so multi-node-in-process harnesses keep one
+    span log per node; each log then holds the process-wide span
+    stream, which `tools/trace_timeline.py` dedupes on merge."""
     log = SpanLog(path, capacity=capacity)
     for d in log.load():
         attrs = dict(d.get("attrs") or {})
@@ -116,5 +119,5 @@ def persist_spans(
             tracer.add(d["name"], float(d["start"]), float(d["end"]), **attrs)
         except Exception:
             continue
-    tracer.set_sink(log.append)
+    tracer.add_sink(log.append)
     return log

@@ -1,0 +1,163 @@
+"""Cross-node trace-context propagation: the distributed half of tracing.
+
+The PR 2 tracer records spans inside one process; this module gives a
+span a *cluster-wide identity* so spans recorded on different nodes can
+be stitched back into one timeline (`tools/trace_timeline.py`). A
+`TraceContext` is minted head-based at the edges of the system — tx
+admission (`mempool/mempool.py`) and vote/proposal creation
+(`consensus/state.py`) — and then rides along two channels:
+
+* **the wire** — an optional trailing block on the p2p frame
+  (`p2p/connection.py`), codec-backward-compatible: old frames carry no
+  block and decode unchanged; decode failures drop the context, never
+  the frame;
+* **the thread** — a thread-ambient slot (`use()` / `current()`): the
+  p2p recv loop sets the decoded context around `on_receive`, reactors
+  hand work to mempool/consensus on the same thread, and the consensus
+  loop re-establishes the record's context while processing it, so
+  gossip-out sends re-attach it without any per-call-site plumbing.
+
+Sampling is head-based and decided once at mint: an unsampled message
+carries NO context bytes on the wire and costs one thread-local read on
+the hot paths. `TENDERMINT_TPU_TRACE_SAMPLE` holds the 1-in-N rate
+(default 64; 0 disables minting; 1 samples everything). Breaker trips
+and mesh re-meshes `boost()` a temporary sample-everything window (the
+transitions are exactly when per-message attribution pays for itself),
+and the nemesis harness forces sampling for the whole chaos run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from tendermint_tpu.codec.binary import Reader, Writer
+
+SAMPLE_ENV = "TENDERMINT_TPU_TRACE_SAMPLE"
+DEFAULT_SAMPLE = 64
+
+# wire-block version tag: a future layout bumps it and old nodes drop
+# the (still well-framed) block instead of misparsing it
+_WIRE_VERSION = 1
+
+_ID_BYTES = 8
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """Compact identity one message carries across the cluster:
+    (trace_id, parent span_id, origin node_id). Immutable — hops
+    re-parent via `rehop()` rather than mutating."""
+
+    trace_id: bytes
+    span_id: bytes
+    origin: str
+
+    @property
+    def trace(self) -> str:
+        """Hex trace id — the attr value every stitched span carries."""
+        return self.trace_id.hex()
+
+    def rehop(self) -> "TraceContext":
+        """Fresh parent span id for the next hop; trace/origin stay."""
+        return TraceContext(self.trace_id, os.urandom(_ID_BYTES), self.origin)
+
+    def encode_wire(self) -> bytes:
+        return (
+            Writer()
+            .uvarint(_WIRE_VERSION)
+            .raw(self.trace_id[:_ID_BYTES].ljust(_ID_BYTES, b"\x00"))
+            .raw(self.span_id[:_ID_BYTES].ljust(_ID_BYTES, b"\x00"))
+            .string(self.origin)
+            .build()
+        )
+
+    @classmethod
+    def decode_wire(cls, r: Reader) -> "TraceContext":
+        version = r.uvarint()
+        if version != _WIRE_VERSION:
+            raise ValueError(f"unknown trace-context version {version}")
+        trace_id = r.raw(_ID_BYTES)
+        span_id = r.raw(_ID_BYTES)
+        origin = r.string()
+        return cls(trace_id, span_id, origin)
+
+
+# -- sampling -----------------------------------------------------------------
+
+_counter = itertools.count()
+_force_all = False
+_boost_until = 0.0
+_boost_lock = threading.Lock()
+
+
+def sample_rate() -> int:
+    """1-in-N mint rate (0 = tracing off). Read per mint so tests and
+    operators can flip the env knob on a live process."""
+    try:
+        return int(os.environ.get(SAMPLE_ENV, str(DEFAULT_SAMPLE)))
+    except ValueError:
+        return DEFAULT_SAMPLE
+
+
+def force_all(on: bool) -> None:
+    """Sample everything until turned off — the nemesis harness arms
+    this for chaos runs so every forensic message is attributable."""
+    global _force_all
+    _force_all = on
+
+
+def boost(duration_s: float = 30.0) -> None:
+    """Sample everything for `duration_s` — called on breaker trips and
+    mesh re-meshes, the moments a dashboard reader will want per-message
+    attribution for."""
+    global _boost_until
+    with _boost_lock:
+        _boost_until = max(_boost_until, time.monotonic() + duration_s)
+
+
+def sampling_forced() -> bool:
+    return _force_all or time.monotonic() < _boost_until
+
+
+def mint(origin: str = "") -> TraceContext | None:
+    """Head-based sampling decision + context creation. Returns None
+    when this message is not sampled — callers then attach nothing and
+    pay nothing downstream."""
+    if not sampling_forced():
+        rate = sample_rate()
+        if rate <= 0:
+            return None
+        if rate > 1 and next(_counter) % rate:
+            return None
+    from tendermint_tpu.telemetry import metrics as _metrics
+
+    _metrics.TRACE_SAMPLED.inc()
+    return TraceContext(os.urandom(_ID_BYTES), os.urandom(_ID_BYTES), origin)
+
+
+# -- thread-ambient propagation ----------------------------------------------
+
+_tls = threading.local()
+
+
+def current() -> TraceContext | None:
+    """The context ambient on this thread (None = untraced work)."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def use(ctx: TraceContext | None):
+    """Install `ctx` as this thread's ambient context for the scope
+    (None explicitly clears, so a traced record can never leak its
+    context onto the next untraced one)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev

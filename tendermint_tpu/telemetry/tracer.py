@@ -1,12 +1,19 @@
 """Lightweight span tracer for consensus/device-path timelines.
 
-Not a distributed tracer — a bounded in-process ring of completed spans
+The in-process half of tracing: a bounded ring of completed spans
 (name, wall-clock start/end, attributes) cheap enough to leave on in
 production. Consensus records one span per round phase
 (`consensus.propose` → `consensus.commit`, attributed with
 height/round), device dispatch records verify/hash batches; the
 `dump_telemetry` RPC serves the recent window so a stalled height can
 be read as a timeline instead of reverse-engineered from logs.
+
+Spans that carry a `trace` attribute (a `telemetry/tracectx.py`
+trace id) are the distributed half: `tools/trace_timeline.py` merges
+span logs from N nodes and stitches same-trace spans into one
+cross-cluster timeline. Every span name recorded with a literal must be
+registered in `telemetry/metrics.py`'s SPAN_CATALOG (collection-time
+lint, same discipline as the metric catalog).
 """
 
 from __future__ import annotations
@@ -16,6 +23,19 @@ import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+
+def _snapshot_attrs(attrs: dict) -> dict:
+    """Copy `attrs` tolerating concurrent writers: a traced path may
+    hand its attrs dict to another thread (callers add attrs mid-span),
+    and a resize during the copy raises RuntimeError — retry, and never
+    let the snapshot kill the traced path."""
+    for _ in range(4):
+        try:
+            return dict(attrs)
+        except RuntimeError:
+            continue
+    return {}
 
 
 @dataclass
@@ -30,42 +50,60 @@ class Span:
         return self.end - self.start
 
     def to_dict(self) -> dict:
+        # attrs are COPIED: a reader serializing the dict must never
+        # observe (or publish) a later writer's mutation
         return {
             "name": self.name,
             "start": self.start,
             "end": self.end,
             "duration_s": self.duration,
-            **({"attrs": self.attrs} if self.attrs else {}),
+            **({"attrs": dict(self.attrs)} if self.attrs else {}),
         }
 
 
 class Tracer:
-    """Bounded ring of completed spans; thread-safe. An optional sink
-    callback observes every completed span (the JSONL span log persists
-    them across restarts — `telemetry/spanlog.py`); sink errors are
-    swallowed, recording must never fail the traced path."""
+    """Bounded ring of completed spans; thread-safe. Optional sink
+    callbacks observe every completed span (the JSONL span log persists
+    them across restarts — `telemetry/spanlog.py`); multiple sinks are
+    supported so multi-node-in-process harnesses can keep one span log
+    per node. Sink errors are swallowed: recording must never fail the
+    traced path."""
 
     def __init__(self, capacity: int = 1024) -> None:
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=capacity)
-        self._sink = None
+        self._sinks: tuple = ()
+
+    def add_sink(self, fn) -> None:
+        """Attach `fn(span)` as an additional completion sink."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks = self._sinks + (fn,)
+
+    def remove_sink(self, fn) -> None:
+        """Detach one sink; other sinks (a successor node's span log)
+        stay installed. Equality, not identity: bound methods are a new
+        object per attribute access, so `log.append` must still match."""
+        with self._lock:
+            self._sinks = tuple(s for s in self._sinks if s != fn)
 
     def set_sink(self, fn) -> None:
-        """Install `fn(span)` as the completion sink (None clears)."""
-        self._sink = fn
+        """Legacy single-sink API: replace ALL sinks with `fn` (None
+        clears)."""
+        with self._lock:
+            self._sinks = () if fn is None else (fn,)
 
     def clear_sink(self, fn) -> None:
-        """Remove the sink only if `fn` is still the installed one —
-        a stopping node must not strip a successor's sink."""
-        if self._sink is fn:
-            self._sink = None
+        """Remove the sink only if `fn` is an installed one — a
+        stopping node must not strip a successor's sink."""
+        self.remove_sink(fn)
 
     def add(self, name: str, start: float, end: float, **attrs) -> Span:
         span = Span(name, start, end, attrs)
         with self._lock:
             self._spans.append(span)
-        sink = self._sink
-        if sink is not None:
+            sinks = self._sinks
+        for sink in sinks:
             try:
                 sink(span)
             except Exception:
@@ -74,8 +112,11 @@ class Tracer:
 
     @contextmanager
     def span(self, name: str, **attrs):
-        """`with TRACER.span("verify.batch", n=512): ...` — the span is
-        recorded on exit, errors included (attr `error` is set)."""
+        """`with TRACER.span("mempool.admission", n=512): ...` — the span
+        recorded on exit, errors included (attr `error` is set). The
+        attrs are SNAPSHOT at completion: the yielded dict may keep
+        being mutated (even from another thread) without racing the
+        recorded span or its readers."""
         t0 = time.time()
         try:
             yield attrs  # callers may add attrs mid-span
@@ -83,7 +124,7 @@ class Tracer:
             attrs["error"] = f"{type(e).__name__}"
             raise
         finally:
-            self.add(name, t0, time.time(), **attrs)
+            self.add(name, t0, time.time(), **_snapshot_attrs(attrs))
 
     def recent(self, n: int | None = None, prefix: str = "") -> list[dict]:
         with self._lock:

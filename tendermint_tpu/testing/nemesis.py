@@ -40,6 +40,13 @@ the sharded-mesh survivor re-mesh cycle a `shard<i>` fault drives; plus
 `wait_telemetry_above` for counters like round skips): what an
 operator's dashboard would show is what the chaos suite checks
 (docs/OBSERVABILITY.md).
+
+Forensics: chaos runs force distributed-trace sampling
+(`tracectx.force_all`) so every message is attributable, and an
+invariant violation dumps the flight recorder
+(`telemetry/flightrec.py`) to the harness home — the dump path is
+appended to the InvariantViolation message, so a red run points at its
+own black box (`tools/trace_timeline.py --flight <dump> --height H`).
 """
 
 from __future__ import annotations
@@ -414,6 +421,14 @@ class Nemesis:
         self.stop(check=exc_type is None)
 
     def start(self) -> None:
+        # chaos runs sample EVERY trace context: when an invariant
+        # trips, the flight-recorder dump + span logs must attribute
+        # every message in flight, not 1-in-64 of them
+        from tendermint_tpu.telemetry import tracectx
+        from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+        tracectx.force_all(True)
+        FLIGHT.set_dump_dir(self.home)
         for node in self.nodes:
             node.start()
         for i in range(len(self.nodes)):
@@ -431,6 +446,9 @@ class Nemesis:
             self._monitor.join(timeout=5)
         for node in self.nodes:
             node.stop()
+        from tendermint_tpu.telemetry import tracectx
+
+        tracectx.force_all(False)
         if check:
             self.assert_invariants()
 
@@ -707,6 +725,18 @@ class Nemesis:
     def heights(self) -> list[int]:
         return [n.store.height for n in self.nodes]
 
+    def _violation(self, msg: str) -> InvariantViolation:
+        """Build the violation AND dump the flight recorder: the ring
+        of round transitions / flushes / launches leading up to the
+        break is the forensic record, and the dump path rides the
+        assertion message so a red CI run is self-diagnosing."""
+        from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+        path = FLIGHT.dump(reason="invariant-violation", dir=self.home)
+        if path:
+            msg = f"{msg} [flight recorder: {path}]"
+        return InvariantViolation(msg)
+
     def check_no_fork(self) -> None:
         """One block hash per height across every store that has it."""
         top = max(self.heights(), default=0)
@@ -717,7 +747,7 @@ class Nemesis:
                 if meta is not None:
                     seen.setdefault(bytes(meta.block_id.hash), node.index)
             if len(seen) > 1:
-                raise InvariantViolation(
+                raise self._violation(
                     f"FORK at height {h}: {[(v, k.hex()[:12]) for k, v in seen.items()]}"
                 )
 
@@ -732,7 +762,7 @@ class Nemesis:
                 if meta is None or commit is None:
                     continue
                 if bytes(commit.block_id.hash) != bytes(meta.block_id.hash):
-                    raise InvariantViolation(
+                    raise self._violation(
                         f"node{node.index} height {h}: seen-commit certifies "
                         f"{commit.block_id.hash.hex()[:12]} but stored block is "
                         f"{meta.block_id.hash.hex()[:12]}"

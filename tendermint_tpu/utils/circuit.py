@@ -73,6 +73,14 @@ class CircuitBreaker:
         def _export(old: str, new: str) -> None:
             metrics.BREAKER_STATE.labels(kind=name).set(STATE_CODES[new])
             metrics.BREAKER_TRANSITIONS.labels(kind=name, to=new).inc()
+            # breaker transitions are forensic moments: black-box the
+            # event and sample-everything for a window so the messages
+            # around the degradation are all attributable
+            from tendermint_tpu.telemetry import tracectx
+            from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+            FLIGHT.record("breaker", breaker=name, frm=old, to=new)
+            tracectx.boost()
 
         self._listeners.append(_export)
 

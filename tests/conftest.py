@@ -72,6 +72,36 @@ def lint_metric_catalog(roots=None) -> list[str]:
     return offenders
 
 
+def lint_span_catalog(roots=None) -> list[str]:
+    """Span-name lint: every literal name passed to `TRACER.span("…")`
+    or `TRACER.add("…", …)` in the package (and tools/) must be
+    registered in `telemetry/metrics.py`'s SPAN_CATALOG — same
+    discipline as the metric lint: an uncataloged span name means a
+    timeline/dashboard query that silently matches nothing. Returns
+    `path:name` offenders."""
+    import pathlib
+    import re
+
+    from tendermint_tpu.telemetry.metrics import SPAN_CATALOG
+
+    repo = pathlib.Path(__file__).resolve().parents[1]
+    if roots is None:
+        roots = [repo / "tendermint_tpu", repo / "tools"]
+    pat = re.compile(r"""TRACER\.(?:span|add)\(\s*["']([a-z0-9_.]+)["']""")
+    offenders: list[str] = []
+    for root in roots:
+        for path in sorted(pathlib.Path(root).rglob("*.py")):
+            for name in pat.findall(path.read_text(encoding="utf-8")):
+                if name in SPAN_CATALOG:
+                    continue
+                try:
+                    shown = path.relative_to(repo)
+                except ValueError:  # lint tests point at tmp dirs
+                    shown = path
+                offenders.append(f"{shown}:{name}")
+    return offenders
+
+
 def pytest_collection_modifyitems(config, items):
     bad = lint_kernel_marks(items)
     if bad:
@@ -85,4 +115,10 @@ def pytest_collection_modifyitems(config, items):
         raise pytest.UsageError(
             "tendermint_* metric names used in code but missing from "
             "telemetry/metrics.py's catalog: " + ", ".join(bad_metrics[:10])
+        )
+    bad_spans = lint_span_catalog()
+    if bad_spans:
+        raise pytest.UsageError(
+            "span names recorded in code but missing from "
+            "telemetry/metrics.py's SPAN_CATALOG: " + ", ".join(bad_spans[:10])
         )

@@ -61,3 +61,22 @@ class TestMetricCatalogLint:
             'PKG = "tendermint_tpu.services"\n'  # package path, not a metric
         )
         assert lint_metric_catalog(roots=[tmp_path]) == []
+
+
+class TestSpanCatalogLint:
+    def test_current_tree_is_clean(self):
+        from tests.conftest import lint_span_catalog
+
+        assert lint_span_catalog() == []
+
+    def test_uncataloged_span_name_is_flagged(self, tmp_path):
+        from tests.conftest import lint_span_catalog
+
+        (tmp_path / "mod.py").write_text(
+            'TRACER.span("not.in.catalog")\n'
+            'TRACER.add("mempool.admission", 0.0, 1.0)\n'  # cataloged
+            'tracer.add("local.variable.skipped", 0.0, 1.0)\n'  # not TRACER
+        )
+        off = lint_span_catalog(roots=[tmp_path])
+        assert len(off) == 1
+        assert off[0].endswith(":not.in.catalog")

@@ -428,3 +428,29 @@ class TestSpanPersistence:
         tr.clear_sink(mine.append)  # stopping node must not strip it
         tr.add("s", 0.0, 1.0)
         assert len(theirs) == 1 and not mine
+
+
+class TestHistogramExemplars:
+    """Exemplar trace ids on histogram observations: the breadcrumb
+    from an aggregate back to one concrete traced request (JSON dump
+    only — text exposition 0.0.4 has no exemplar syntax)."""
+
+    def test_observe_with_exemplar_surfaces_in_snapshots(self):
+        reg = Registry()
+        h = Histogram("h", "", buckets=(1.0,), registry=reg)
+        h.observe(0.5)
+        assert "exemplar" not in h.value
+        h.observe(0.7, exemplar="feedface01")
+        assert h.value["exemplar"] == "feedface01"
+        series = reg.to_dict()["h"]["series"][0]
+        assert series["exemplar"] == "feedface01"
+        # text exposition is unchanged by exemplars
+        assert "exemplar" not in reg.prometheus_text()
+
+    def test_labeled_children_keep_independent_exemplars(self):
+        reg = Registry()
+        h = Histogram("h", "", labelnames=("stage",), buckets=(1.0,), registry=reg)
+        h.labels(stage="drain").observe(0.1, exemplar="aaaa")
+        h.labels(stage="verify").observe(0.2)
+        assert h.labels(stage="drain").value["exemplar"] == "aaaa"
+        assert "exemplar" not in h.labels(stage="verify").value

@@ -361,6 +361,68 @@ def drive_dedup_steady_state(heights: int, n_vals: int, launch_ms: float) -> dic
     }
 
 
+def drive_tracing_overhead(heights: int, n_vals: int, launch_ms: float) -> dict:
+    """Bench guard for the distributed tracer: verifies/s on the
+    dedup_steady_state replay with head-based sampling at the
+    production default (1-in-64) must sit within 3% of tracing-off.
+    The traced run exercises the real costs: the ambient thread-local
+    read at every coalescer submit, plus flush/launch spans and flight
+    events for the sampled heights."""
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+    from tendermint_tpu.telemetry import tracectx as _tc
+
+    height_triples = [
+        _salted_sigs(n_vals, b"trace-h%d" % h) for h in range(heights)
+    ]
+
+    def run(sample: int) -> float:
+        prev = os.environ.get(_tc.SAMPLE_ENV)
+        os.environ[_tc.SAMPLE_ENV] = str(sample)
+        v = CoalescingVerifier(
+            _LaunchLatencyVerifier(launch_ms / 1e3),
+            cache_size=65536,
+            window_s=0.001,
+        )
+        try:
+            total = 0
+            t0 = time.perf_counter()
+            for triples in height_triples:
+                # the admission edge: head-sampled mint, then the whole
+                # height's verify work runs with the context ambient
+                # (exactly the consensus vote-drain shape)
+                with _tc.use(_tc.mint("bench") if sample else None):
+                    for consumer in ("consensus", "fastsync"):
+                        assert bool(
+                            v.verify_batch_async(triples, consumer=consumer)
+                            .result(timeout=60)
+                            .all()
+                        )
+                total += 2 * len(triples)
+            return total / (time.perf_counter() - t0)
+        finally:
+            v.close()
+            if prev is None:
+                os.environ.pop(_tc.SAMPLE_ENV, None)
+            else:
+                os.environ[_tc.SAMPLE_ENV] = prev
+
+    run(0)  # warmup: host-crypto/thread spin-up would bias the first run
+    off_vps = run(0)
+    on_vps = run(64)
+    overhead_pct = 100.0 * (1.0 - on_vps / off_vps)
+    return {
+        "heights": heights,
+        "validators": n_vals,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "sample_rate": 64,
+        "tracing_off_verifies_per_s": round(off_vps, 1),
+        "tracing_on_verifies_per_s": round(on_vps, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_3pct": overhead_pct <= 3.0,
+    }
+
+
 def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> dict:
     """All four verify consumers live at once: consensus, fast-sync,
     statesync, and rpc threads submit concurrent async batches through
@@ -688,6 +750,15 @@ def main(argv=None) -> int:
         coalesce_multiconsumer = drive_coalesce_multiconsumer(
             args.coalesce_rounds, args.coalesce_batch, args.launch_ms
         )
+    tracing_overhead = None
+    if args.dedup_heights > 0:
+        sys.stderr.write(
+            f"driving tracing overhead guard {args.dedup_heights} heights x "
+            f"{args.dedup_vals} vals (sampling off vs 1/64)...\n"
+        )
+        tracing_overhead = drive_tracing_overhead(
+            args.dedup_heights, args.dedup_vals, args.launch_ms
+        )
     sharded_verify = None
     if args.mesh:
         sys.stderr.write(
@@ -705,6 +776,7 @@ def main(argv=None) -> int:
         "fastsync_pipeline": fastsync_pipeline,
         "dedup_steady_state": dedup_steady_state,
         "coalesce_multiconsumer": coalesce_multiconsumer,
+        "tracing_overhead": tracing_overhead,
         "sharded_verify": sharded_verify,
         "wal_fsync": {
             "count": wal_count,
