@@ -97,12 +97,19 @@ class StateSyncConfig:
 
 @dataclass
 class MempoolConfig:
-    """Reference `config/config.go:267-288`."""
+    """Reference `config/config.go:267-288` + the ingress pipeline
+    (`mempool/ingress.py`): `lanes` shards the pool into tx-hash
+    partitions (0 = env `TENDERMINT_TPU_MEMPOOL_LANES` or the built-in
+    default), `ingress_batch` merges concurrent CheckTx arrivals into
+    verify windows through the coalescer (`TENDERMINT_TPU_INGRESS_BATCH=0`
+    overrides to the legacy synchronous path)."""
 
     recheck: bool = True
     broadcast: bool = True
     wal_dir: str = "data/mempool.wal"
     cache_size: int = 100_000
+    lanes: int = 0  # 0 = env/default (mempool.DEFAULT_LANES)
+    ingress_batch: bool = True
 
 
 @dataclass

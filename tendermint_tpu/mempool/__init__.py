@@ -1,5 +1,16 @@
 """Mempool (reference `mempool/`)."""
 
+from tendermint_tpu.mempool.ingress import (
+    IngressBatcher,
+    make_signed_tx,
+    parse_signed_tx,
+)
 from tendermint_tpu.mempool.mempool import Mempool, TxCache
 
-__all__ = ["Mempool", "TxCache"]
+__all__ = [
+    "IngressBatcher",
+    "Mempool",
+    "TxCache",
+    "make_signed_tx",
+    "parse_signed_tx",
+]

@@ -67,8 +67,13 @@ class MempoolReactor(Reactor):
     def receive(self, chan_id: int, peer: Peer, payload: bytes) -> None:
         tx = decode_tx_message(payload)
         # bad txs answer with a code; gossip just drops them (reference
-        # `Receive :74-86` ignores CheckTx results from peers)
-        self.mempool.check_tx(tx)
+        # `Receive :74-86` ignores CheckTx results from peers). The
+        # non-blocking submit keeps the recv thread off the verify
+        # window: the tx joins the next ingress batch and this thread
+        # goes back to draining frames (the sender's trace context is
+        # ambient here and captured at submit).
+        submit = getattr(self.mempool, "check_tx_async", None)
+        (submit or self.mempool.check_tx)(tx)
 
     def _broadcast_routine(self, peer: Peer) -> None:
         """Reference `broadcastTxRoutine :114-152`. The cursor is the

@@ -133,7 +133,10 @@ class Node:
             self.app_conns
         )
 
-        # mempool + tx index
+        # mempool + tx index. The shared verifier makes CheckTx windows
+        # the verify spine's fifth consumer (consumer="mempool"):
+        # admission signature batches coalesce with consensus/fastsync/
+        # statesync/rpc launches and share the VerifiedSigCache.
         self.mempool = Mempool(
             self.app_conns.mempool,
             height=self.state.last_block_height,
@@ -141,6 +144,9 @@ class Node:
             wal_dir=cfg.mempool_wal_path() if cfg.mempool.wal_dir else None,
             recheck=cfg.mempool.recheck,
             node_id=self.node_id,
+            lanes=cfg.mempool.lanes or None,
+            verifier=verifier,
+            ingress_batch=cfg.mempool.ingress_batch,
         )
         # re-validate txs that were in flight before a crash; the WAL is
         # compacted to the survivors so it cannot grow across restarts

@@ -318,7 +318,11 @@ def make_routes(node) -> dict:
 
     def broadcast_tx_async(tx: str) -> dict:
         raw = _decode_tx(tx)
-        node.mempool.check_tx(raw)
+        # fire-and-forget (reference BroadcastTxAsync returns before
+        # CheckTx): the tx joins the next ingress verify window and this
+        # handler thread is free for the next request
+        submit = getattr(node.mempool, "check_tx_async", None)
+        (submit or node.mempool.check_tx)(raw)
         return {"hash": tx_hash(raw).hex()}
 
     def broadcast_tx_sync(tx: str) -> dict:

@@ -175,7 +175,8 @@ DISPATCH_OVERLAP = Histogram(
 # -- verify coalescer + dedup cache (services/batcher.py) ---------------------
 #
 # `consumer` labels are the verify-request owners ("consensus",
-# "fastsync", "statesync", "rpc", "default") — a fixed small set.
+# "fastsync", "statesync", "rpc", "mempool", "default") — a fixed
+# small set.
 
 VERIFY_CACHE_HITS = Counter(
     "tendermint_verify_cache_hits_total",
@@ -251,6 +252,7 @@ SPAN_CATALOG = frozenset(
         "consensus.commit",
         "consensus.height",
         "mempool.admission",
+        "mempool.window",
         "p2p.hop",
         "batcher.flush",
         "dispatch.launch",
@@ -346,13 +348,38 @@ P2P_SEND_QUEUE_MAX = Gauge(
 )
 
 # -- mempool ------------------------------------------------------------------
+#
+# `result` outcomes are fixed: ok / rejected (app said no) / duplicate
+# (dup-cache hit) / bad_sig (signed-envelope verify failed). Ingress
+# `reason` mirrors the coalescer's flush triggers (window/size/barrier).
 
 MEMPOOL_SIZE = Gauge("tendermint_mempool_size", "Pending txs in the mempool")
 MEMPOOL_TXS = Counter(
     "tendermint_mempool_txs_total",
-    "CheckTx outcomes (ok/rejected/duplicate)",
+    "CheckTx outcomes (ok/rejected/duplicate/bad_sig)",
     labelnames=("result",),
 )
+MEMPOOL_ADMISSION_SECONDS = Histogram(
+    "tendermint_mempool_admission_seconds",
+    "CheckTx arrival to admission verdict (ingress queue + verify window "
+    "+ app check); exemplar-linked to the admitted tx's trace id",
+    buckets=LATENCY_BUCKETS,
+)
+MEMPOOL_INGRESS_WINDOW = Histogram(
+    "tendermint_mempool_ingress_window_txs",
+    "Txs merged per ingress verify window",
+    buckets=SIZE_BUCKETS,
+)
+MEMPOOL_INGRESS_FLUSH = Counter(
+    "tendermint_mempool_ingress_flush_total",
+    "Ingress window flushes by trigger (window/size/barrier)",
+    labelnames=("reason",),
+)
+
+for _reason in ("window", "size", "barrier"):
+    MEMPOOL_INGRESS_FLUSH.labels(reason=_reason).inc(0)
+for _result in ("ok", "rejected", "duplicate", "bad_sig"):
+    MEMPOOL_TXS.labels(result=_result).inc(0)
 
 # -- consensus WAL ------------------------------------------------------------
 

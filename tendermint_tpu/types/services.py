@@ -37,6 +37,9 @@ class NopMempool:
     def check_tx(self, tx: Tx, cb: Callable | None = None) -> None:
         pass
 
+    def check_tx_async(self, tx: Tx, cb: Callable | None = None) -> None:
+        pass
+
     def reap(self, max_txs: int) -> Txs:
         return Txs()
 

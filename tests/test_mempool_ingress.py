@@ -1,0 +1,448 @@
+"""Traffic-scale mempool ingress: sharded tx lanes + batched admission
+windows through the verify coalescer (`mempool/ingress.py`)."""
+
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.abci.apps import CounterApp, KVStoreApp
+from tendermint_tpu.abci.client import local_client_creator
+from tendermint_tpu.abci.types import CodeType
+from tendermint_tpu.crypto.keys import gen_priv_key
+from tendermint_tpu.mempool import Mempool, make_signed_tx, parse_signed_tx
+from tendermint_tpu.services.batcher import CoalescingVerifier
+from tendermint_tpu.services.verifier import HostBatchVerifier
+from tendermint_tpu.telemetry import REGISTRY
+from tendermint_tpu.types.tx import Txs
+
+
+def _mempool(app=None, **kw):
+    conns = local_client_creator(app or KVStoreApp())()
+    return Mempool(conns.mempool, **kw), conns
+
+
+PRIV = gen_priv_key(b"\x42" * 32)
+
+
+def _signed(payload: bytes, priv=PRIV) -> bytes:
+    return make_signed_tx(priv, payload)
+
+
+class TestSignedTxEnvelope:
+    def test_roundtrip(self):
+        tx = _signed(b"k=v")
+        parsed = parse_signed_tx(tx)
+        assert parsed is not None
+        pk, sig, payload = parsed
+        assert pk == PRIV.pub_key.data and payload == b"k=v"
+        assert PRIV.pub_key.verify(payload, sig)
+
+    def test_plain_and_short_txs_are_not_envelopes(self):
+        assert parse_signed_tx(b"k=v") is None
+        assert parse_signed_tx(b"\xed\x01short") is None
+        # magic alone is not enough: header must be complete
+        assert parse_signed_tx(b"\xed\x01" + b"\x00" * 95) is None
+
+    def test_tampered_payload_fails_verify(self):
+        tx = bytearray(_signed(b"k=v"))
+        tx[-1] ^= 0xFF
+        pk, sig, payload = parse_signed_tx(bytes(tx))
+        assert not PRIV.pub_key.verify(payload, sig)
+
+
+class TestLanes:
+    def test_reap_merges_lanes_in_counter_order(self):
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        txs = [b"k%d=v%d" % (i, i) for i in range(24)]
+        for tx in txs:
+            mp.check_tx(tx)
+        # txs spread over multiple lanes...
+        occupied = [lane for lane in mp._lanes if lane.txs]
+        assert len(occupied) > 1
+        # ...but reap returns global admission order, counter-monotonic
+        reaped = [bytes(t) for t in mp.reap(-1)]
+        assert reaped == txs
+        counters = [c for c, _ in mp.get_after(0)]
+        assert counters == sorted(counters) == list(range(1, 25))
+        assert [bytes(t) for t in mp.reap(5)] == txs[:5]
+
+    def test_update_removes_committed_and_rechecks_across_lanes(self):
+        app = CounterApp(serial=True)
+        mp, conns = _mempool(app, lanes=4, ingress_batch=False)
+        txs = [i.to_bytes(1, "big") if i else b"\x00" for i in range(6)]
+        for tx in txs:
+            mp.check_tx(tx)
+        assert mp.size() == 6
+        # app advances past nonce 3 -> nonces 2,3 go stale on recheck
+        for i in range(4):
+            conns.consensus.deliver_tx_async(txs[i])
+        mp.lock()
+        try:
+            mp.update(1, Txs(txs[:2]))  # 0,1 committed
+        finally:
+            mp.unlock()
+        survivors = [bytes(t) for t in mp.reap(-1)]
+        assert survivors == txs[4:]  # 2,3 rechecked stale, 4,5 survive
+
+    def test_dup_cache_hits_land_on_the_right_lane(self):
+        mp, _ = _mempool(lanes=8, ingress_batch=False)
+        txs = [b"dup%d=%d" % (i, i) for i in range(16)]
+        for tx in txs:
+            assert mp.check_tx(tx).is_ok
+        for tx in txs:
+            res = mp.check_tx(tx)
+            assert res.code == CodeType.TX_IN_CACHE
+        assert mp.size() == 16
+        # eviction from the owning lane's segment makes the tx re-offerable
+        mp.lock()
+        try:
+            mp.update(1, Txs(txs))
+        finally:
+            mp.unlock()
+        assert mp.size() == 0
+        # committed txs stay in their lane's dup cache (a gossip
+        # re-arrival of a committed tx is still a duplicate)
+        assert mp.check_tx(txs[0]).code == CodeType.TX_IN_CACHE
+
+    def test_wal_replay_restores_every_lane(self, tmp_path):
+        txs = [b"wal%d=%d" % (i, i) for i in range(12)]
+        mp, _ = _mempool(lanes=4, ingress_batch=False, wal_dir=str(tmp_path))
+        for tx in txs:
+            mp.check_tx(tx)
+        mp.close()
+        mp2, _ = _mempool(lanes=4, ingress_batch=False, wal_dir=str(tmp_path))
+        assert mp2.replay_wal() == 12
+        assert [bytes(t) for t in mp2.reap(-1)] == txs
+        # every lane that should hold a tx holds exactly its txs
+        for tx in txs:
+            lane = mp2._lane_for(tx)
+            assert any(m.tx == tx for m in lane.txs)
+        mp2.close()
+
+    def test_lock_freezes_all_lanes(self):
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        mp.check_tx(b"a=1")
+        mp.lock()
+        try:
+            blocked = threading.Event()
+            done = threading.Event()
+
+            def admit():
+                blocked.set()
+                mp.check_tx(b"b=2")
+                done.set()
+
+            t = threading.Thread(target=admit, daemon=True)
+            t.start()
+            blocked.wait(2)
+            time.sleep(0.1)
+            # admission can't complete while consensus holds the pool
+            assert not done.is_set()
+        finally:
+            mp.unlock()
+        assert done.wait(5)
+        assert mp.size() == 2
+
+
+class TestWALConcurrentWriters:
+    def test_concurrent_appends_keep_framing_parseable(self, tmp_path):
+        """Pre-fix, check_tx appended outside any lock: interleaved
+        writes from RPC + gossip threads corrupted the length framing
+        load_wal replays. The dedicated WAL lock serializes appends."""
+        mp, _ = _mempool(lanes=4, ingress_batch=False, wal_dir=str(tmp_path))
+        n_threads, per_thread = 8, 40
+        # variable-length payloads make torn frames visible
+        txs = [
+            b"t%d-%d=%s" % (k, i, b"x" * (1 + (k * per_thread + i) % 97))
+            for k in range(n_threads)
+            for i in range(per_thread)
+        ]
+
+        def worker(k):
+            for tx in txs[k * per_thread : (k + 1) * per_thread]:
+                mp.check_tx(tx)
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        records = mp.load_wal()
+        assert len(records) == n_threads * per_thread
+        assert set(records) == set(txs)
+        mp.close()
+
+
+class TestGetAfterWait:
+    def test_spurious_wakeup_does_not_return_empty(self):
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        mp.check_tx(b"a=1")
+        cursor = max(c for c, _ in mp.get_after(0))
+        got = []
+
+        def waiter():
+            got.extend(mp.get_after(cursor, wait=True, timeout=10))
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        # spurious notify: no newer tx exists — the waiter must re-wait
+        for _ in range(3):
+            with mp._avail:
+                mp._avail.notify_all()
+            time.sleep(0.02)
+        assert t.is_alive(), "waiter returned on a spurious wakeup"
+        mp.check_tx(b"b=2")
+        t.join(5)
+        assert [tx for _, tx in got] == [b"b=2"]
+
+    def test_timeout_expires_empty(self):
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        mp.check_tx(b"a=1")
+        cursor = max(c for c, _ in mp.get_after(0))
+        t0 = time.monotonic()
+        out = mp.get_after(cursor, wait=True, timeout=0.3)
+        assert out == []
+        assert time.monotonic() - t0 >= 0.25
+
+    def test_spurious_wakeup_respects_deadline(self):
+        """A storm of notifies without new txs must neither return
+        early nor spin past the deadline."""
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        mp.check_tx(b"a=1")
+        cursor = max(c for c, _ in mp.get_after(0))
+        stop = threading.Event()
+
+        def noise():
+            while not stop.is_set():
+                with mp._avail:
+                    mp._avail.notify_all()
+                time.sleep(0.01)
+
+        t = threading.Thread(target=noise, daemon=True)
+        t.start()
+        try:
+            t0 = time.monotonic()
+            out = mp.get_after(cursor, wait=True, timeout=0.3)
+            dt = time.monotonic() - t0
+        finally:
+            stop.set()
+            t.join(2)
+        assert out == []
+        assert 0.25 <= dt < 5.0
+
+
+def _coalescing(cache_size=4096, window_s=0.001):
+    return CoalescingVerifier(
+        HostBatchVerifier(), cache_size=cache_size, window_s=window_s
+    )
+
+
+class TestIngressBatcher:
+    def test_batched_results_match_legacy(self):
+        seq = [
+            _signed(b"s1=1"),
+            b"plain=1",
+            _signed(b"s2=2"),
+            _signed(b"s1=1"),  # duplicate
+        ]
+        forged = bytearray(_signed(b"s3=3"))
+        forged[40] ^= 0xFF
+        seq.append(bytes(forged))
+
+        def run(batch_on):
+            v = _coalescing()
+            mp, _ = _mempool(lanes=4, ingress_batch=batch_on, verifier=v)
+            codes = [mp.check_tx(tx).code for tx in seq]
+            size = mp.size()
+            mp.close()
+            v.close()
+            return codes, size
+
+        legacy, batched = run(False), run(True)
+        assert legacy == batched
+        assert legacy[0] == (
+            [CodeType.OK, CodeType.OK, CodeType.OK, CodeType.TX_IN_CACHE,
+             CodeType.UNAUTHORIZED]
+        )
+
+    def test_forged_sig_rejected_evicted_and_retryable(self):
+        v = _coalescing()
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        good = _signed(b"k=v")
+        forged = bytearray(good)
+        forged[34] ^= 0x01  # flip one sig bit
+        res = mp.check_tx(bytes(forged))
+        assert res.code == CodeType.UNAUTHORIZED
+        assert mp.size() == 0
+        # the forged bytes were evicted from the dup cache: the CORRECT
+        # envelope is admissible (a bad sig can't poison the tx)
+        assert mp.check_tx(good).is_ok
+        assert mp.size() == 1
+        mp.close()
+        v.close()
+
+    def test_concurrent_callers_share_verify_windows(self):
+        v = _coalescing(cache_size=0)
+        mp, _ = _mempool(
+            lanes=4, ingress_batch=True, verifier=v, ingress_window_s=0.02
+        )
+        fam = REGISTRY.get("tendermint_mempool_ingress_window_txs")
+        n0, s0 = fam.value["count"], fam.value["sum"]
+        txs = [_signed(b"w%d=%d" % (i, i)) for i in range(48)]
+        threads = [
+            threading.Thread(target=mp.check_tx, args=(tx,)) for tx in txs
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert mp.size() == 48
+        snap = fam.value
+        windows = snap["count"] - n0
+        assert snap["sum"] - s0 == 48
+        assert windows < 48, "no admission coalescing happened"
+        mp.close()
+        v.close()
+
+    def test_mempool_is_a_coalescer_consumer(self):
+        v = _coalescing(cache_size=0)
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        fam = REGISTRY.get("tendermint_batcher_wait_seconds")
+        before = fam.labels(consumer="mempool").value["count"]
+        for i in range(4):
+            assert mp.check_tx(_signed(b"c%d=%d" % (i, i))).is_ok
+        assert fam.labels(consumer="mempool").value["count"] > before
+        mp.close()
+        v.close()
+
+    def test_gossip_rearrival_is_near_free_via_sig_cache(self):
+        """Two nodes' mempools share one verifier stack (the in-process
+        nemesis shape): the second admission of the same signed tx hits
+        the VerifiedSigCache instead of re-verifying."""
+        v = _coalescing()
+        mp_a, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        mp_b, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        tx = _signed(b"gossip=1")
+        assert mp_a.check_tx(tx).is_ok
+        h0 = REGISTRY.counter_value("tendermint_verify_cache_hits_total")
+        assert mp_b.check_tx(tx).is_ok
+        assert (
+            REGISTRY.counter_value("tendermint_verify_cache_hits_total") - h0
+            >= 1
+        )
+        mp_a.close()
+        mp_b.close()
+        v.close()
+
+    def test_callbacks_fire_in_submission_order(self):
+        v = _coalescing(cache_size=0)
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        order = []
+        done = threading.Event()
+        n = 20
+
+        def cb_for(i):
+            def cb(res):
+                order.append(i)
+                if len(order) == n:
+                    done.set()
+
+            return cb
+
+        for i in range(n):
+            mp.check_tx_async(_signed(b"f%d=%d" % (i, i)), cb_for(i))
+        assert done.wait(10)
+        assert order == list(range(n))
+        mp.close()
+        v.close()
+
+    def test_window_degrades_to_host_verify_when_verifier_faults(self):
+        class ExplodingVerifier(HostBatchVerifier):
+            def verify_batch_async(self, triples, queue=None, consumer="default"):
+                raise RuntimeError("device gone")
+
+        mp, _ = _mempool(
+            lanes=4, ingress_batch=True, verifier=ExplodingVerifier()
+        )
+        good = _signed(b"h=1")
+        forged = bytearray(_signed(b"h2=2"))
+        forged[40] ^= 0xFF
+        assert mp.check_tx(good).is_ok
+        assert mp.check_tx(bytes(forged)).code == CodeType.UNAUTHORIZED
+        assert mp.size() == 1
+        mp.close()
+
+    def test_window_degrades_when_handle_result_faults(self):
+        class FaultyHandle:
+            def result(self, timeout=None):
+                raise RuntimeError("launch lost")
+
+        class FaultyVerifier(HostBatchVerifier):
+            def verify_batch_async(self, triples, queue=None, consumer="default"):
+                return FaultyHandle()
+
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=FaultyVerifier())
+        assert mp.check_tx(_signed(b"h3=3")).is_ok
+        mp.close()
+
+    def test_env_opt_out_keeps_synchronous_semantics(self, monkeypatch):
+        monkeypatch.setenv("TENDERMINT_TPU_INGRESS_BATCH", "0")
+        mp, _ = _mempool(lanes=4)
+        assert mp._ingress is None
+        assert mp.check_tx(_signed(b"sync=1")).is_ok
+        # check_tx_async falls back to the synchronous path
+        res = mp.check_tx_async(b"plain=2")
+        assert res.is_ok and mp.size() == 2
+        mp.close()
+
+    def test_env_lane_override(self, monkeypatch):
+        monkeypatch.setenv("TENDERMINT_TPU_MEMPOOL_LANES", "2")
+        mp, _ = _mempool(lanes=8, ingress_batch=False)
+        assert mp.n_lanes == 2
+        mp.close()
+
+    def test_admission_latency_histogram_observed(self):
+        fam = REGISTRY.get("tendermint_mempool_admission_seconds")
+        before = fam.value["count"]
+        v = _coalescing()
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        mp.check_tx(_signed(b"lat=1"))
+        mp.check_tx(b"plain-lat=1")
+        assert fam.value["count"] >= before + 2
+        mp.close()
+        v.close()
+
+    def test_close_resolves_queued_admissions(self):
+        """A closing pool must not wedge blocked callers: queued
+        admissions resolve with an internal error."""
+        v = _coalescing()
+        mp, _ = _mempool(
+            lanes=4, ingress_batch=True, verifier=v, ingress_window_s=5.0
+        )
+        adm = mp.check_tx_async(_signed(b"late=1"))
+        mp.close()
+        v.close()
+        res = adm.wait(5) if hasattr(adm, "wait") else adm
+        assert res is not None
+
+    def test_no_empty_block_wakeup_fires_from_window_join(self):
+        v = _coalescing()
+        mp, _ = _mempool(lanes=4, ingress_batch=True, verifier=v)
+        fired = []
+        mp.set_on_txs_available(lambda: fired.append(1))
+        mp.check_tx(_signed(b"wake=1"))
+        mp.check_tx(_signed(b"wake2=2"))
+        assert len(fired) == 1  # once per height
+        mp.lock()
+        try:
+            mp.update(1, Txs([_signed(b"wake=1")]))
+        finally:
+            mp.unlock()
+        # recheck left wake2 pending -> fires again for the next height
+        assert len(fired) == 2
+        mp.close()
+        v.close()

@@ -403,3 +403,95 @@ class TestPipelineNoFork:
             # the overlap histogram saw the joiner's windows: the
             # pipeline actually engaged (not the synchronous fallback)
             assert overlap.labels(queue="fastsync").value["count"] > joins_before
+
+
+class TestIngressChaos:
+    """ISSUE 8 acceptance: sustained load-generator traffic keeps
+    flowing through the batched ingress pipeline (sharded lanes +
+    verify windows) while the network partitions AND the verify breaker
+    trips to host crypto — with ZERO loss from the admitted pool (every
+    CheckTx that answered OK is eventually committed) and no fork."""
+
+    def test_sustained_ingress_through_partition_heal_and_breaker_trip(
+        self, tmp_path, monkeypatch
+    ):
+        import itertools
+        import threading
+
+        from tendermint_tpu.crypto.keys import gen_priv_key
+        from tendermint_tpu.mempool import make_signed_tx
+
+        monkeypatch.setenv("TENDERMINT_TPU_MEMPOOL_LANES", "4")
+        priv = gen_priv_key(b"\x33" * 32)
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(),
+            verifier_factory=_resilient_factory(threshold=2, reset_s=0.5),
+        ) as net:
+            net.wait_height(2, timeout=90)
+            # the full production mempool shape is active on every node
+            assert net.nodes[0].node.mempool.n_lanes == 4
+            assert net.nodes[0].node.mempool._ingress is not None
+
+            admitted: list[bytes] = []
+            adm_lock = threading.Lock()
+            stop = threading.Event()
+            seq = itertools.count()
+
+            def pump():
+                """Open-loop traffic: signed txs at a steady arrival
+                rate into two intake nodes' ingress pipelines (the
+                RPC-broadcast shape), regardless of admission progress."""
+                for i in seq:
+                    if stop.is_set() or i >= 1200:
+                        return
+                    tx = make_signed_tx(priv, b"ing-%d=%d" % (i, i))
+
+                    def cb(res, tx=tx):
+                        if res.is_ok:
+                            with adm_lock:
+                                admitted.append(tx)
+
+                    net.nodes[i % 2].node.mempool.check_tx_async(tx, cb)
+                    time.sleep(0.008)
+
+            pump_thread = threading.Thread(target=pump, daemon=True)
+            pump_thread.start()
+            try:
+                time.sleep(0.5)  # traffic established pre-fault
+                base = net.breaker_baseline("verify")
+                net.partition({0, 1, 2}, {3})  # minority isolated
+                fail.set_device_fault("verify")  # device dies under load
+                net.wait_progress(delta=2, nodes=[0, 1, 2], timeout=90)
+                net.assert_breaker_tripped(base, min_trips=1)
+                fail.clear_device_faults()
+                net.heal()
+                net.wait_progress(delta=2, timeout=90)
+            finally:
+                stop.set()
+                pump_thread.join(10)
+            with adm_lock:
+                final_admitted = list(admitted)
+            assert final_admitted, "no tx was admitted under chaos"
+
+            # zero admitted-tx loss: every OK admission commits
+            def committed_txs() -> set:
+                store = net.nodes[0].store
+                out = set()
+                for h in range(max(1, store.base), store.height + 1):
+                    blk = store.load_block(h)
+                    if blk is not None:
+                        out.update(bytes(t) for t in blk.data.txs)
+                return out
+
+            deadline = time.monotonic() + 120
+            missing = set(final_admitted)
+            while time.monotonic() < deadline and missing:
+                missing = set(final_admitted) - committed_txs()
+                if missing:
+                    time.sleep(0.5)
+            assert not missing, (
+                f"{len(missing)}/{len(final_admitted)} admitted txs lost"
+            )
+            net.check_invariants()  # no fork through the whole episode

@@ -484,6 +484,168 @@ def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> d
     }
 
 
+class _DeviceShapeVerifier:
+    """CPU stand-in for the device verifier's INGRESS shape: a fixed
+    launch cost plus the measured device marginal per-signature cost,
+    both spent OFF the GIL (exactly what an in-flight kernel looks like
+    to the host), with a real host-crypto spot check of a sample so the
+    emulation can't return verdicts for garbage. The ingress comparison
+    is architectural — launch-per-tx vs launch-per-window — and the
+    launch is the term the device actually charges (~86 ms through the
+    axon tunnel; per-sig marginal ~0.7 µs at the PR 6 ~1.45M/s table
+    rate). Flagged `emulated_launch` like every CPU-seed section."""
+
+    accepts_consumer = True
+
+    def __init__(self, launch_s: float, per_sig_s: float = 2e-6, sample: int = 2):
+        from tendermint_tpu.services.verifier import HostBatchVerifier
+
+        self._host = HostBatchVerifier()
+        self._launch_s = launch_s
+        self._per_sig_s = per_sig_s
+        self._sample = sample
+
+    def verify_batch(self, triples):
+        import numpy as np
+
+        time.sleep(self._launch_s + self._per_sig_s * len(triples))
+        n = len(triples)
+        idx = list(range(0, n, max(1, n // self._sample)))[: self._sample]
+        spot = self._host.verify_batch([triples[i] for i in idx])
+        return np.full(n, bool(spot.all()), dtype=bool)
+
+    launch_verify_batch = verify_batch
+
+    def finalize_verify_batch(self, launched):
+        return launched
+
+    def verify_batch_async(self, triples, queue=None, consumer: str = "default"):
+        from tendermint_tpu.services.dispatch import default_dispatch_queue
+
+        q = queue if queue is not None else default_dispatch_queue()
+        return q.submit(lambda: self.verify_batch(triples), kind="verify")
+
+
+def drive_mempool_ingress(
+    n_txs: int, threads: int, launch_ms: float, lanes_list=(1, 4, 8)
+) -> dict:
+    """`mempool_ingress` section: signed CheckTx traffic through the
+    REAL admission paths — legacy one-at-a-time (launch per tx, the
+    pre-ingress shape) vs the batched+sharded pipeline (launch per
+    verify window through the coalescer) — at 1/4/8 lanes, with p99
+    admission latency read from the same histogram a node exports."""
+    import threading
+
+    from tendermint_tpu.abci.apps import NilApp
+    from tendermint_tpu.abci.client import local_client_creator
+    from tendermint_tpu.crypto.keys import gen_priv_key
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.mempool.ingress import make_signed_tx
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+
+    privs = [gen_priv_key(bytes([i % 256]) * 32) for i in range(16)]
+    sys.stderr.write(f"  pre-signing {n_txs} txs...\n")
+    tx_sets: dict = {}
+
+    def txs_for(run_key: str) -> list[bytes]:
+        # distinct payloads per run so dup caches never cross runs
+        if run_key not in tx_sets:
+            tx_sets[run_key] = [
+                make_signed_tx(
+                    privs[i % len(privs)], b"%s/k%d=%d" % (run_key.encode(), i, i)
+                )
+                for i in range(n_txs)
+            ]
+        return tx_sets[run_key]
+
+    def run(run_key: str, batch_on: bool, lanes: int) -> dict:
+        conns = local_client_creator(NilApp())()
+        verifier = CoalescingVerifier(
+            _DeviceShapeVerifier(launch_ms / 1e3),
+            cache_size=0,
+            window_s=0.001,
+        )
+        mp = Mempool(
+            conns.mempool,
+            cache_size=4 * n_txs,
+            verifier=verifier,
+            lanes=lanes,
+            ingress_batch=batch_on,
+        )
+        txs = txs_for(run_key)
+        n0, _, _, _ = _histo("tendermint_mempool_admission_seconds")
+        errors: list = []
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+        done = threading.Event()
+
+        def worker(k: int) -> None:
+            # the RPC-broadcast / gossip-recv shape: non-blocking
+            # submits, results via callback — intake threads never
+            # stall on a window join, so windows grow with load
+            try:
+                for tx in txs[k::threads]:
+                    t_sub = time.perf_counter()
+
+                    def cb(res, t_sub=t_sub):
+                        if not res.is_ok:
+                            errors.append(res.log)
+                        with lat_lock:
+                            lat.append(time.perf_counter() - t_sub)
+                            if len(lat) == n_txs:
+                                done.set()
+
+                    mp.check_tx_async(tx, cb)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(repr(e))
+                done.set()
+
+        ts = [threading.Thread(target=worker, args=(k,)) for k in range(threads)]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert done.wait(timeout=120), "ingress admissions did not drain"
+        dt = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        assert mp.size() == n_txs
+        mp.close()
+        verifier.close()
+        n1, _, _, _ = _histo("tendermint_mempool_admission_seconds")
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p99 = lat[min(len(lat) - 1, int(0.99 * (len(lat) - 1)))]
+        return {
+            "lanes": lanes,
+            "batched": batch_on,
+            "checktx_per_s": round(n_txs / dt, 1),
+            "p50_admission_ms": round(p50 * 1e3, 3),
+            "p99_admission_ms": round(p99 * 1e3, 3),
+            # proof the exported histogram saw this run's admissions
+            "admissions_observed": int(n1 - n0),
+        }
+
+    sys.stderr.write("  legacy one-at-a-time path...\n")
+    legacy = run("legacy", batch_on=False, lanes=1)
+    rows = []
+    for lanes in lanes_list:
+        sys.stderr.write(f"  batched ingress, {lanes} lanes...\n")
+        rows.append(run(f"b{lanes}", batch_on=True, lanes=lanes))
+    best = max(rows, key=lambda r: r["checktx_per_s"])
+    return {
+        "txs": n_txs,
+        "threads": threads,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "signed": True,
+        "target_device_checktx_per_s": 100_000,
+        "legacy": legacy,
+        "batched": rows,
+        "speedup": round(best["checktx_per_s"] / legacy["checktx_per_s"], 3),
+    }
+
+
 def drive_mesh_scaling(batch: int, reps: int, device_counts=(1, 2, 4, 8)) -> dict | None:
     """`sharded_verify` section: the REAL mesh kernels at mesh widths
     1/2/4/8 — verifies/s, per-launch commit-tally latency, and scaling
@@ -687,6 +849,35 @@ def main(argv=None) -> int:
         dest="mesh_batch",
         help="signatures per launch in the mesh-scaling section",
     )
+    ap.add_argument(
+        "--ingress",
+        action="store_true",
+        help="run the mempool_ingress section (batched+sharded CheckTx "
+        "admission vs the legacy one-at-a-time path at 1/4/8 lanes)",
+    )
+    ap.add_argument(
+        "--ingress-txs",
+        type=int,
+        default=1024,
+        dest="ingress_txs",
+        help="signed txs per ingress run",
+    )
+    ap.add_argument(
+        "--ingress-threads",
+        type=int,
+        default=8,
+        dest="ingress_threads",
+        help="concurrent CheckTx submitter threads",
+    )
+    ap.add_argument(
+        "--ingress-launch-ms",
+        type=float,
+        default=5.0,
+        dest="ingress_launch_ms",
+        help="emulated device launch cost per ingress verify call "
+        "(kept small so the legacy run finishes; real figure is the "
+        "86 ms axon tunnel)",
+    )
     args = ap.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
@@ -759,6 +950,15 @@ def main(argv=None) -> int:
         tracing_overhead = drive_tracing_overhead(
             args.dedup_heights, args.dedup_vals, args.launch_ms
         )
+    mempool_ingress = None
+    if args.ingress:
+        sys.stderr.write(
+            f"driving mempool ingress {args.ingress_txs} signed txs x "
+            f"{args.ingress_threads} threads (legacy vs batched @ 1/4/8 lanes)...\n"
+        )
+        mempool_ingress = drive_mempool_ingress(
+            args.ingress_txs, args.ingress_threads, args.ingress_launch_ms
+        )
     sharded_verify = None
     if args.mesh:
         sys.stderr.write(
@@ -777,6 +977,7 @@ def main(argv=None) -> int:
         "dedup_steady_state": dedup_steady_state,
         "coalesce_multiconsumer": coalesce_multiconsumer,
         "tracing_overhead": tracing_overhead,
+        "mempool_ingress": mempool_ingress,
         "sharded_verify": sharded_verify,
         "wal_fsync": {
             "count": wal_count,

@@ -1,0 +1,264 @@
+"""Open-loop mempool load generator (ROADMAP open item 2).
+
+Drives CheckTx traffic the way "millions of users" would: arrivals are
+scheduled by a fixed-rate open-loop process (a slow mempool does NOT
+slow the generator down — backlog shows up as admission latency, the
+honest serving metric per ACE-style sub-second targets), spread over N
+client threads, with configurable payload size, hot-key skew, and
+duplicate re-sends (gossip-style re-arrivals that should be near-free
+through the dup cache / VerifiedSigCache).
+
+Two targets:
+
+* in-process (default): builds a KVStore app + the production mempool
+  shape (sharded lanes + ingress batching over `default_verifier()`),
+  then reads latency back from the same
+  `tendermint_mempool_admission_seconds` histogram a node exports;
+* `--rpc host:port`: fires `broadcast_tx_sync` at a running node.
+
+    JAX_PLATFORMS=cpu python tools/loadgen.py --rate 20000 --duration 3
+    python tools/loadgen.py --rate 100000 --threads 16 --signed  # TPU
+    python tools/loadgen.py --rpc 127.0.0.1:46657 --rate 500
+
+Output: one JSON summary line on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[idx]
+
+
+class TxFactory:
+    """Payload builder with hot-key and duplicate skew. Hot keys model
+    many users hammering the same state (`k<i>=` collides app-side);
+    duplicates model gossip re-arrivals of the SAME tx bytes."""
+
+    def __init__(self, payload: int, hot_keys: int, hot_prob: float,
+                 dup_prob: float, signed: bool, signers: int, seed: int = 7):
+        self._rng = random.Random(seed)
+        self._payload = max(8, payload)
+        self._hot_keys = max(0, hot_keys)
+        self._hot_prob = hot_prob
+        self._dup_prob = dup_prob
+        self._recent: list[bytes] = []
+        self._recent_lock = threading.Lock()
+        self._privs = []
+        if signed:
+            from tendermint_tpu.crypto.keys import gen_priv_key
+
+            self._privs = [
+                gen_priv_key(bytes([i % 256]) * 32) for i in range(max(1, signers))
+            ]
+
+    def make(self, n: int) -> bytes:
+        rng = self._rng
+        if self._dup_prob > 0 and rng.random() < self._dup_prob:
+            with self._recent_lock:
+                if self._recent:
+                    return self._recent[rng.randrange(len(self._recent))]
+        if self._hot_keys and rng.random() < self._hot_prob:
+            key = b"hot%d" % rng.randrange(self._hot_keys)
+        else:
+            key = b"k%d" % n
+        body = b"%s=%d;" % (key, n)
+        body += b"x" * max(0, self._payload - len(body))
+        if self._privs:
+            from tendermint_tpu.mempool.ingress import make_signed_tx
+
+            tx = make_signed_tx(self._privs[n % len(self._privs)], body)
+        else:
+            tx = body
+        with self._recent_lock:
+            self._recent.append(tx)
+            if len(self._recent) > 4096:
+                self._recent.pop(0)
+        return tx
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.outcomes: dict[str, int] = {}
+        self.latencies: list[float] = []
+        self.late_arrivals = 0
+
+    def record(self, outcome: str, latency_s: float) -> None:
+        with self.lock:
+            self.outcomes[outcome] = self.outcomes.get(outcome, 0) + 1
+            self.latencies.append(latency_s)
+
+
+def _outcome(code: int) -> str:
+    return {0: "ok", 4: "bad_sig", 5: "duplicate"}.get(code, "rejected")
+
+
+def run_inprocess(args, factory: TxFactory, stats: Stats):
+    from tendermint_tpu.abci.apps import KVStoreApp
+    from tendermint_tpu.abci.client import local_client_creator
+    from tendermint_tpu.mempool import Mempool
+    from tendermint_tpu.services.verifier import default_verifier
+
+    conns = local_client_creator(KVStoreApp())()
+    verifier = default_verifier()
+    mp = Mempool(
+        conns.mempool,
+        cache_size=10_000_000,
+        verifier=verifier,
+        lanes=args.lanes or None,
+        ingress_batch=not args.legacy,
+    )
+
+    def submit(tx, t_sched):
+        def cb(res, t_sched=t_sched):
+            stats.record(_outcome(res.code), time.perf_counter() - t_sched)
+
+        mp.check_tx_async(tx, cb)
+
+    drain = lambda: None  # noqa: E731
+    return mp, submit, drain
+
+
+def run_rpc(args, factory: TxFactory, stats: Stats):
+    import urllib.request
+
+    url = f"http://{args.rpc}/"
+
+    def submit(tx, t_sched):
+        req = urllib.request.Request(
+            url,
+            data=json.dumps(
+                {
+                    "jsonrpc": "2.0",
+                    "id": 1,
+                    "method": "broadcast_tx_sync",
+                    "params": {"tx": tx.hex()},
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.load(resp)
+            code = int(out.get("result", {}).get("code", 1))
+            stats.record(_outcome(code), time.perf_counter() - t_sched)
+        except Exception:
+            stats.record("error", time.perf_counter() - t_sched)
+
+    return None, submit, lambda: None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=5000.0, help="offered tx/s (open loop)")
+    ap.add_argument("--duration", type=float, default=3.0, help="seconds of traffic")
+    ap.add_argument("--threads", type=int, default=8, help="client threads")
+    ap.add_argument("--payload", type=int, default=64, help="payload bytes")
+    ap.add_argument("--hot-keys", type=int, default=16, dest="hot_keys",
+                    help="hot-key pool size (0 disables)")
+    ap.add_argument("--hot-prob", type=float, default=0.2, dest="hot_prob",
+                    help="probability an arrival uses a hot key")
+    ap.add_argument("--dup-prob", type=float, default=0.0, dest="dup_prob",
+                    help="probability an arrival re-sends recent tx bytes")
+    ap.add_argument("--signed", action="store_true",
+                    help="wrap payloads in the signed-tx envelope")
+    ap.add_argument("--signers", type=int, default=16)
+    ap.add_argument("--lanes", type=int, default=0, help="mempool lanes (0=default)")
+    ap.add_argument("--legacy", action="store_true",
+                    help="ingress batching OFF (one-at-a-time admission)")
+    ap.add_argument("--rpc", default="", help="host:port of a running node "
+                    "(default: in-process mempool)")
+    args = ap.parse_args(argv)
+
+    factory = TxFactory(
+        args.payload, args.hot_keys, args.hot_prob, args.dup_prob,
+        args.signed, args.signers,
+    )
+    stats = Stats()
+    mp, submit, drain = (
+        run_rpc(args, factory, stats) if args.rpc
+        else run_inprocess(args, factory, stats)
+    )
+
+    n_total = int(args.rate * args.duration)
+    interval = 1.0 / args.rate if args.rate > 0 else 0.0
+    t0 = time.perf_counter() + 0.05  # shared epoch for all threads
+
+    def worker(k: int):
+        late = 0
+        for n in range(k, n_total, args.threads):
+            due = t0 + n * interval
+            now = time.perf_counter()
+            if due > now:
+                time.sleep(due - now)
+            elif now - due > 0.001:
+                late += 1  # open loop: fire immediately, count the slip
+            submit(factory.make(n), due)
+        with stats.lock:
+            stats.late_arrivals += late
+
+    sys.stderr.write(
+        f"offering {args.rate:.0f} tx/s x {args.duration}s over "
+        f"{args.threads} threads ({n_total} txs)...\n"
+    )
+    threads = [
+        threading.Thread(target=worker, args=(k,)) for k in range(args.threads)
+    ]
+    wall0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # wait for in-flight admissions to resolve
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        with stats.lock:
+            if len(stats.latencies) >= n_total:
+                break
+        time.sleep(0.05)
+    wall = time.perf_counter() - wall0
+    drain()
+    if mp is not None:
+        mp.close()
+
+    with stats.lock:
+        lat = sorted(stats.latencies)
+        outcomes = dict(stats.outcomes)
+        late = stats.late_arrivals
+    out = {
+        "offered_rate": args.rate,
+        "duration_s": args.duration,
+        "threads": args.threads,
+        "payload_bytes": args.payload,
+        "signed": bool(args.signed),
+        "dup_prob": args.dup_prob,
+        "hot_prob": args.hot_prob,
+        "mode": "rpc" if args.rpc else ("legacy" if args.legacy else "batched"),
+        "submitted": n_total,
+        "resolved": len(lat),
+        "achieved_checktx_per_s": round(len(lat) / wall, 1) if wall > 0 else None,
+        "outcomes": outcomes,
+        "late_arrivals": late,
+        "admission_p50_ms": round(_percentile(lat, 0.50) * 1e3, 3) if lat else None,
+        "admission_p99_ms": round(_percentile(lat, 0.99) * 1e3, 3) if lat else None,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

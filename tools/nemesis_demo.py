@@ -22,12 +22,123 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def run_ingress_scenario(args) -> int:
+    """Ingress-under-chaos: sustained signed-tx loadgen traffic into a
+    FULL-node network's batched admission pipeline through a partition
+    heal + verify-breaker trip — every tx that answered OK must commit
+    (zero admitted-pool loss), no fork."""
+    import itertools
+    import threading
+
+    from tendermint_tpu.crypto.keys import gen_priv_key
+    from tendermint_tpu.mempool import make_signed_tx
+    from tendermint_tpu.services.resilient import ResilientVerifier
+    from tendermint_tpu.services.verifier import HostBatchVerifier
+    from tendermint_tpu.testing import Nemesis
+    from tendermint_tpu.utils import fail
+    from tendermint_tpu.utils.circuit import CircuitBreaker
+
+    def verifier_factory(_i: int) -> ResilientVerifier:
+        return ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.5),
+            max_retries=0,
+        )
+
+    priv = gen_priv_key(b"\x33" * 32)
+    t_all = time.time()
+    with Nemesis(
+        args.nodes,
+        home=tempfile.mkdtemp(prefix="nemesis-ingress-"),
+        node_factory=Nemesis.full_node_factory(),
+        verifier_factory=verifier_factory,
+    ) as net:
+        print(f"[1/5] healthy full-node network of {args.nodes} ...")
+        net.wait_height(2, timeout=args.timeout)
+
+        admitted: list[bytes] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+        seq = itertools.count()
+
+        def pump():
+            for i in seq:
+                if stop.is_set() or i >= args.txs:
+                    return
+                tx = make_signed_tx(priv, b"demo-%d=%d" % (i, i))
+
+                def cb(res, tx=tx):
+                    if res.is_ok:
+                        with lock:
+                            admitted.append(tx)
+
+                net.nodes[i % 2].node.mempool.check_tx_async(tx, cb)
+                time.sleep(1.0 / args.rate)
+
+        print(f"[2/5] open-loop signed traffic at {args.rate:.0f} tx/s ...")
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.5)
+            print("[3/5] partition minority + trip the verify breaker ...")
+            net.partition(set(range(args.nodes - 1)), {args.nodes - 1})
+            fail.set_device_fault("verify")
+            net.wait_progress(
+                delta=2, nodes=list(range(args.nodes - 1)), timeout=args.timeout
+            )
+            print("[4/5] clear fault + heal; traffic still flowing ...")
+            fail.clear_device_faults()
+            net.heal()
+            net.wait_progress(delta=2, timeout=args.timeout)
+        finally:
+            stop.set()
+            t.join(10)
+        with lock:
+            final = list(admitted)
+        print(f"[5/5] draining: {len(final)} admitted txs must all commit ...")
+        deadline = time.time() + args.timeout
+        missing = set(final)
+        while time.time() < deadline and missing:
+            store = net.nodes[0].store
+            committed = set()
+            for h in range(max(1, store.base), store.height + 1):
+                blk = store.load_block(h)
+                if blk is not None:
+                    committed.update(bytes(x) for x in blk.data.txs)
+            missing = set(final) - committed
+            if missing:
+                time.sleep(0.5)
+        if missing:
+            print(f"FAILED: {len(missing)} admitted txs lost")
+            return 1
+        net.check_invariants()
+        print(
+            f"done in {time.time() - t_all:.1f}s; zero admitted-tx loss, "
+            "no fork"
+        )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
     ap.add_argument("--heights", type=int, default=3, help="heights per phase")
     ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument(
+        "--ingress",
+        action="store_true",
+        help="run the ingress-under-chaos scenario (full nodes + loadgen "
+        "traffic through partition heal + breaker trip) instead",
+    )
+    ap.add_argument("--rate", type=float, default=150.0, help="ingress tx/s")
+    ap.add_argument("--txs", type=int, default=1000, help="ingress tx cap")
     args = ap.parse_args()
+
+    if args.ingress:
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging("resilient:info,nemesis:info,*:error")
+        return run_ingress_scenario(args)
 
     from tendermint_tpu.services.resilient import ResilientVerifier
     from tendermint_tpu.services.verifier import HostBatchVerifier
