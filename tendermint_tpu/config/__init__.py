@@ -102,7 +102,11 @@ class MempoolConfig:
     partitions (0 = env `TENDERMINT_TPU_MEMPOOL_LANES` or the built-in
     default), `ingress_batch` merges concurrent CheckTx arrivals into
     verify windows through the coalescer (`TENDERMINT_TPU_INGRESS_BATCH=0`
-    overrides to the legacy synchronous path)."""
+    overrides to the legacy synchronous path), `signed_txs` enables
+    signed-envelope recognition — when on, the `0xED 0x01` tx prefix is
+    reserved (see mempool/ingress.py); turn off for chains whose apps
+    may emit payloads colliding with it (`TENDERMINT_TPU_SIGNED_TXS=0`
+    overrides). All nodes of a chain must agree on `signed_txs`."""
 
     recheck: bool = True
     broadcast: bool = True
@@ -110,6 +114,7 @@ class MempoolConfig:
     cache_size: int = 100_000
     lanes: int = 0  # 0 = env/default (mempool.DEFAULT_LANES)
     ingress_batch: bool = True
+    signed_txs: bool = True
 
 
 @dataclass

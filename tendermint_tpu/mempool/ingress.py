@@ -22,13 +22,23 @@ Signed-tx envelope (the payload signature CheckTx verifies):
 
 `parse_signed_tx` returns None for anything else — plain txs ride the
 same windows but skip the signature stage (the app's CheckTx remains
-their only gate, exactly the reference's behavior).
+their only gate).
+
+CONSENSUS-RELEVANT: with envelope recognition on (the default), the
+`0xED 0x01` prefix is RESERVED — a plain app payload that happens to
+start with those two bytes and is >= 98 bytes long is classified as an
+envelope, signature-verified, and rejected UNAUTHORIZED instead of
+reaching the app. Chains whose apps may emit such payloads must opt
+out (`TENDERMINT_TPU_SIGNED_TXS=0`, config `[mempool] signed_txs`, or
+`Mempool(signed_txs=False)`), which restores unconditional
+pass-through; all nodes of a chain must agree on the setting.
 
 Env knobs (mirroring the TENDERMINT_TPU_COALESCE discipline):
   TENDERMINT_TPU_INGRESS_BATCH=0      legacy synchronous admission
   TENDERMINT_TPU_INGRESS_WINDOW_MS    flush window (default 2 ms)
   TENDERMINT_TPU_INGRESS_MAX_BATCH    txs per window (default 1024)
   TENDERMINT_TPU_MEMPOOL_LANES        pool lanes (mempool.py)
+  TENDERMINT_TPU_SIGNED_TXS=0         disable envelope recognition
 """
 
 from __future__ import annotations
@@ -80,6 +90,7 @@ class _Admission:
         "cb",
         "ctx",
         "t_admit",
+        "gen",
         "parsed",
         "event",
         "result",
@@ -87,11 +98,12 @@ class _Admission:
         "submitted_at",
     )
 
-    def __init__(self, tx, cb, ctx, t_admit, parsed):
+    def __init__(self, tx, cb, ctx, t_admit, parsed, gen=None):
         self.tx = tx
         self.cb = cb
         self.ctx = ctx
         self.t_admit = t_admit
+        self.gen = gen  # mempool flush generation at submit
         self.parsed = parsed
         self.event = threading.Event()
         self.result: Result | None = None
@@ -124,9 +136,11 @@ class IngressBatcher:
         verifier=None,
         window_s: float | None = None,
         max_batch: int | None = None,
+        signed_txs: bool = True,
     ) -> None:
         self._mempool = mempool
         self._verifier = verifier
+        self._signed_txs = signed_txs
         if window_s is None:
             window_s = (
                 float(os.environ.get("TENDERMINT_TPU_INGRESS_WINDOW_MS", "2.0"))
@@ -185,11 +199,28 @@ class IngressBatcher:
             self._queue.clear()
         for adm in leftovers:
             self._finish(adm, Result(CodeType.INTERNAL_ERROR, log="mempool closed"))
+        # a flusher stuck past its join timeout can enqueue a window
+        # AFTER the _STOP sentinel; the joiner exits at _STOP without
+        # resolving it and _Admission.wait() has no timeout — drain the
+        # join queue so no blocked caller hangs on an unresolved batch
+        while True:
+            try:
+                item = self._join_q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is _STOP:
+                continue
+            _handle, batch, _signed = item
+            for adm in batch:
+                self._finish(
+                    adm, Result(CodeType.INTERNAL_ERROR, log="mempool closed")
+                )
 
     # -- submit side -------------------------------------------------------
 
-    def submit(self, tx: bytes, cb, ctx, t_admit) -> _Admission:
-        adm = _Admission(tx, cb, ctx, t_admit, parse_signed_tx(tx))
+    def submit(self, tx: bytes, cb, ctx, t_admit, gen=None) -> _Admission:
+        parsed = parse_signed_tx(tx) if self._signed_txs else None
+        adm = _Admission(tx, cb, ctx, t_admit, parsed, gen)
         self._ensure_threads()
         with self._cond:
             if self._closed:
@@ -307,7 +338,8 @@ class IngressBatcher:
                 sig_ok = ok_by_id.get(id(adm))  # None for plain txs
                 try:
                     res = self._mempool._admit_checked(
-                        adm.tx, adm.ctx, adm.t_admit, sig_ok=sig_ok
+                        adm.tx, adm.ctx, adm.t_admit, sig_ok=sig_ok,
+                        gen=adm.gen,
                     )
                 except Exception as e:  # admission must never wedge a caller
                     res = Result(CodeType.INTERNAL_ERROR, log=f"admission: {e}")

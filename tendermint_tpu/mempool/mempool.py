@@ -122,6 +122,15 @@ def _resolve_ingress(ingress_batch: bool | None) -> bool:
     return True
 
 
+def _resolve_signed(signed_txs: bool | None) -> bool:
+    env = os.environ.get("TENDERMINT_TPU_SIGNED_TXS")
+    if env is not None:
+        return env != "0"
+    if signed_txs is not None:
+        return bool(signed_txs)
+    return True
+
+
 class Mempool:
     """Implements `types.services.MempoolI`."""
 
@@ -138,6 +147,7 @@ class Mempool:
         ingress_batch: bool | None = None,
         ingress_window_s: float | None = None,
         ingress_max_batch: int | None = None,
+        signed_txs: bool | None = None,
     ) -> None:
         self._app = app_conn
         n_lanes = _resolve_lanes(lanes)
@@ -147,9 +157,14 @@ class Mempool:
         self._counter_lock = threading.Lock()
         self._height = height
         self._recheck = recheck
+        # Bumped by flush() while every lane lock is held; admissions
+        # capture it at submit and re-check it under the lane lock at
+        # insert, so a tx queued in an ingress window when the operator
+        # flushes cannot re-enter the pool afterwards.
+        self._flush_gen = 0
         # Lock ordering discipline (deadlock-free by construction):
-        #   _avail -> lane locks        (get_after's wait+rescan)
-        #   lane locks -> _counter_lock (admission insert)
+        #   _avail -> lane locks                    (get_after's wait+rescan)
+        #   lane locks -> _wal_lock -> _counter_lock (admission insert)
         # Nothing acquires _avail while holding a lane lock: admissions
         # insert under the lane lock, RELEASE it, then notify. The
         # once-per-height "txs available" latch has its own tiny lock so
@@ -168,15 +183,22 @@ class Mempool:
         self._wal = None
         # Appends are length-framed; concurrent RPC + gossip admissions
         # used to interleave partial writes and corrupt the framing
-        # load_wal replays. One dedicated lock serializes appends (and
-        # keeps WAL order == admission order, which replay_wal's
-        # compaction and the tests rely on).
+        # load_wal replays. One dedicated lock serializes appends AND is
+        # the ordering point for counter assignment: counter and WAL
+        # record are produced under one _wal_lock hold, so WAL order ==
+        # counter (admission) order, which replay for nonce-style serial
+        # apps relies on.
         self._wal_lock = threading.Lock()
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
             self._wal = open(os.path.join(wal_dir, "wal"), "ab")
-        # batched ingress: signature windows through the verify spine
+        # batched ingress: signature windows through the verify spine.
+        # _signed_txs gates envelope recognition: the 0xED 0x01 prefix is
+        # RESERVED when on (a colliding app payload is treated as an
+        # envelope and must carry a valid signature); chains whose apps
+        # emit arbitrary payloads opt out and every tx passes through.
         self._verifier = verifier
+        self._signed_txs = _resolve_signed(signed_txs)
         self._ingress = None
         if _resolve_ingress(ingress_batch):
             from tendermint_tpu.mempool.ingress import IngressBatcher
@@ -186,6 +208,7 @@ class Mempool:
                 verifier=verifier,
                 window_s=ingress_window_s,
                 max_batch=ingress_max_batch,
+                signed_txs=self._signed_txs,
             )
 
     # -- lanes ---------------------------------------------------------------
@@ -219,9 +242,13 @@ class Mempool:
         return total
 
     def flush(self) -> None:
-        """Drop everything (unsafe_flush_mempool RPC)."""
+        """Drop everything (unsafe_flush_mempool RPC) — including txs
+        still queued in ingress windows: the generation bump invalidates
+        every in-flight admission, checked under the lane lock at insert
+        time, so nothing queued before the flush re-enters after it."""
         self.lock()
         try:
+            self._flush_gen += 1
             for lane in self._lanes:
                 lane.txs.clear()
                 lane.cache.reset()
@@ -243,11 +270,11 @@ class Mempool:
         dup = self._dup_or_submit_ctx(tx, cb)
         if isinstance(dup, Result):
             return dup
-        ctx, t_admit = dup
+        ctx, t_admit, gen = dup
         if self._ingress is not None:
-            adm = self._ingress.submit(tx, cb, ctx, t_admit)
+            adm = self._ingress.submit(tx, cb, ctx, t_admit, gen)
             return self._ingress.wait(adm)
-        return self._check_tx_sync(tx, cb, ctx, t_admit)
+        return self._check_tx_sync(tx, cb, ctx, t_admit, gen)
 
     def check_tx_async(self, tx: Tx, cb: Callable[[Result], None] | None = None):
         """Non-blocking admission: queue the tx for the next verify
@@ -261,17 +288,18 @@ class Mempool:
         dup = self._dup_or_submit_ctx(tx, cb)
         if isinstance(dup, Result):
             return dup
-        ctx, t_admit = dup
+        ctx, t_admit, gen = dup
         if self._ingress is not None:
-            return self._ingress.submit(tx, cb, ctx, t_admit)
-        return self._check_tx_sync(tx, cb, ctx, t_admit)
+            return self._ingress.submit(tx, cb, ctx, t_admit, gen)
+        return self._check_tx_sync(tx, cb, ctx, t_admit, gen)
 
     def _dup_or_submit_ctx(self, tx: bytes, cb):
         """Shared synchronous admission prologue: lane dup-cache push
         (so an immediate re-offer is rejected before any window) and
         trace-context capture on the CALLING thread (the p2p recv loop
         installs the sender's context ambient; batcher threads have
-        none). Returns a Result for duplicates, else (ctx, t_admit)."""
+        none). Returns a Result for duplicates, else
+        (ctx, t_admit, flush_gen)."""
         if not self._lane_for(tx).cache.push(tx):
             # Non-zero code so RPC/broadcast callers can distinguish an
             # accepted tx from a silently-dropped duplicate (reference
@@ -291,20 +319,20 @@ class Mempool:
         ctx = _trace.current()
         if ctx is None:
             ctx = _trace.mint(self._node_id)
-        return ctx, t_admit
+        return ctx, t_admit, self._flush_gen
 
-    def _check_tx_sync(self, tx: bytes, cb, ctx, t_admit) -> Result:
+    def _check_tx_sync(self, tx: bytes, cb, ctx, t_admit, gen=None) -> Result:
         """The legacy one-at-a-time admission path (ingress batching
         off): signed envelopes verify inline — one signature, one
         verify call — exactly the host-side shape the batched pipeline
         exists to replace."""
         from tendermint_tpu.mempool.ingress import parse_signed_tx
 
-        parsed = parse_signed_tx(tx)
+        parsed = parse_signed_tx(tx) if self._signed_txs else None
         sig_ok = None
         if parsed is not None:
             sig_ok = self._verify_sig_inline(parsed)
-        res = self._admit_checked(tx, ctx, t_admit, sig_ok=sig_ok)
+        res = self._admit_checked(tx, ctx, t_admit, sig_ok=sig_ok, gen=gen)
         if cb is not None:
             cb(res)
         return res
@@ -326,12 +354,25 @@ class Mempool:
         except Exception:
             return False
 
-    def _admit_checked(self, tx: bytes, ctx, t_admit, sig_ok=None) -> Result:
-        """Post-signature admission: WAL append, app CheckTx, lane
-        insert, telemetry. `sig_ok` is the envelope verdict (None for
-        unsigned txs); a failed signature never reaches the app or the
-        WAL and is evicted from the dup cache so a corrected re-offer
-        re-verifies."""
+    def _admit_checked(self, tx: bytes, ctx, t_admit, sig_ok=None, gen=None) -> Result:
+        """Post-signature admission: app CheckTx, then counter + WAL +
+        lane insert as one atomic step, telemetry. `sig_ok` is the
+        envelope verdict (None for unsigned txs); a failed signature
+        never reaches the app or the WAL and is evicted from the dup
+        cache so a corrected re-offer re-verifies. `gen` is the flush
+        generation captured at submit: a mismatch under the lane lock
+        means the operator flushed while this tx sat in an ingress
+        window, so it must not re-enter the pool (or the WAL).
+
+        Counter assignment, WAL append, and lane append all happen under
+        the lane lock (with _wal_lock as the cross-lane ordering point),
+        which two invariants depend on: WAL record order == counter
+        order (nonce-style serial apps replay in admission order), and
+        _collect_after's counter-snapshot bound (a counter <= the
+        snapshot is guaranteed visible once the lane lock is acquired).
+        The WAL is best-effort (flushed, never fsync'd per tx, and only
+        admitted txs are logged — an app-rejected tx would be dropped at
+        replay's re-validation anyway)."""
         lane = self._lane_for(tx)
         if sig_ok is False:
             lane.cache.remove(tx)
@@ -341,24 +382,32 @@ class Mempool:
             )
             self._finish_admission(tx, ctx, t_admit, res)
             return res
-        if self._wal is not None:
-            # length-framed (txs are arbitrary bytes); buffered+flushed but
-            # NOT fsync'd per tx — the mempool WAL is best-effort, unlike
-            # the consensus WAL (matches the reference's autofile writer)
-            from tendermint_tpu.codec.binary import encode_bytes
-
-            with self._wal_lock:
-                wal = self._wal
-                if wal is not None:
-                    wal.write(encode_bytes(tx))
-                    wal.flush()
         res = self._app.check_tx_async(tx)
         if res.is_ok:
+            from tendermint_tpu.codec.binary import encode_bytes
+
             with lane.lock:
-                with self._counter_lock:
-                    self._counter += 1
-                    counter = self._counter
-                lane.txs.append(MempoolTx(counter, self._height, tx))
+                stale = gen is not None and gen != self._flush_gen
+                if not stale:
+                    with self._wal_lock:
+                        with self._counter_lock:
+                            self._counter += 1
+                            counter = self._counter
+                        if self._wal is not None:
+                            self._wal.write(encode_bytes(tx))
+                            self._wal.flush()
+                    lane.txs.append(MempoolTx(counter, self._height, tx))
+            if stale:
+                # flushed while queued: the pool (and dup caches) were
+                # wiped after this tx was accepted into a window — don't
+                # resurrect it
+                _metrics.MEMPOOL_TXS.labels(result="flushed").inc()
+                res = Result(
+                    code=CodeType.INTERNAL_ERROR,
+                    log="mempool flushed during admission",
+                )
+                self._finish_admission(tx, ctx, t_admit, res, label="flushed")
+                return res
             if ctx is not None:
                 with self._trace_lock:
                     self._traces[tx_hash(tx)] = (ctx, t_admit)
@@ -377,7 +426,9 @@ class Mempool:
         self._finish_admission(tx, ctx, t_admit, res)
         return res
 
-    def _finish_admission(self, tx: bytes, ctx, t_admit, res: Result) -> None:
+    def _finish_admission(
+        self, tx: bytes, ctx, t_admit, res: Result, label: str | None = None
+    ) -> None:
         """Admission telemetry shared by every outcome: the p99-tracked
         latency histogram (exemplar-linked to the trace id) and the
         admission span."""
@@ -387,7 +438,9 @@ class Mempool:
             exemplar=ctx.trace if ctx is not None else None,
         )
         if ctx is not None:
-            if res.is_ok:
+            if label is not None:
+                result = label
+            elif res.is_ok:
                 result = "ok"
             elif res.code == CodeType.UNAUTHORIZED:
                 result = "bad_sig"
@@ -512,11 +565,29 @@ class Mempool:
         fire()
 
     def _collect_after(self, counter: int) -> list[tuple[int, bytes]]:
+        """Lane-by-lane scan with a GAP-FREE guarantee for cursor-style
+        callers (the gossip reactor advances its cursor to the max
+        returned counter, so a skipped counter would never be gossiped).
+
+        Lanes are scanned one lock at a time, so without a bound a tx
+        admitted into an already-scanned lane could be masked by a
+        higher-counter tx admitted into a lane scanned later — the
+        cursor would jump past it forever. The counter snapshot taken
+        BEFORE the walk closes that race: counters <= `hi` were
+        assigned inside a lane-lock critical section that also appends
+        the tx (see _admit_checked), and that section had already begun
+        when we read `hi` — so acquiring the lane lock afterwards
+        observes the append. Counters > `hi` are withheld until the
+        next scan, when a fresh snapshot covers them."""
+        with self._counter_lock:
+            hi = self._counter
         out: list[tuple[int, bytes]] = []
         for lane in self._lanes:
             with lane.lock:
                 out.extend(
-                    (m.counter, m.tx) for m in lane.txs if m.counter > counter
+                    (m.counter, m.tx)
+                    for m in lane.txs
+                    if counter < m.counter <= hi
                 )
         out.sort(key=lambda p: p[0])
         return out

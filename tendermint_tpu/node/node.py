@@ -147,6 +147,7 @@ class Node:
             lanes=cfg.mempool.lanes or None,
             verifier=verifier,
             ingress_batch=cfg.mempool.ingress_batch,
+            signed_txs=cfg.mempool.signed_txs,
         )
         # re-validate txs that were in flight before a crash; the WAL is
         # compacted to the survivors so it cannot grow across restarts

@@ -350,13 +350,14 @@ P2P_SEND_QUEUE_MAX = Gauge(
 # -- mempool ------------------------------------------------------------------
 #
 # `result` outcomes are fixed: ok / rejected (app said no) / duplicate
-# (dup-cache hit) / bad_sig (signed-envelope verify failed). Ingress
-# `reason` mirrors the coalescer's flush triggers (window/size/barrier).
+# (dup-cache hit) / bad_sig (signed-envelope verify failed) / flushed
+# (operator flush invalidated an in-flight admission). Ingress `reason`
+# mirrors the coalescer's flush triggers (window/size/barrier).
 
 MEMPOOL_SIZE = Gauge("tendermint_mempool_size", "Pending txs in the mempool")
 MEMPOOL_TXS = Counter(
     "tendermint_mempool_txs_total",
-    "CheckTx outcomes (ok/rejected/duplicate/bad_sig)",
+    "CheckTx outcomes (ok/rejected/duplicate/bad_sig/flushed)",
     labelnames=("result",),
 )
 MEMPOOL_ADMISSION_SECONDS = Histogram(
@@ -378,7 +379,7 @@ MEMPOOL_INGRESS_FLUSH = Counter(
 
 for _reason in ("window", "size", "barrier"):
     MEMPOOL_INGRESS_FLUSH.labels(reason=_reason).inc(0)
-for _result in ("ok", "rejected", "duplicate", "bad_sig"):
+for _result in ("ok", "rejected", "duplicate", "bad_sig", "flushed"):
     MEMPOOL_TXS.labels(result=_result).inc(0)
 
 # -- consensus WAL ------------------------------------------------------------

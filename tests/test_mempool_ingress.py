@@ -175,6 +175,32 @@ class TestWALConcurrentWriters:
         assert set(records) == set(txs)
         mp.close()
 
+    def test_concurrent_appends_preserve_admission_order(self, tmp_path):
+        """WAL record order must equal counter (admission) order even
+        with concurrent writers: the counter is assigned under the same
+        _wal_lock hold as the WAL append, so crash replay re-admits txs
+        in exactly the order the pool held them (nonce-style serial
+        apps depend on this)."""
+        mp, _ = _mempool(lanes=4, ingress_batch=False, wal_dir=str(tmp_path))
+        n_threads, per_thread = 8, 25
+
+        def worker(k):
+            for i in range(per_thread):
+                mp.check_tx(b"ord-%d-%d=1" % (k, i))
+
+        threads = [
+            threading.Thread(target=worker, args=(k,)) for k in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        counter_of = {tx: c for c, tx in mp.get_after(0)}
+        wal_counters = [counter_of[r] for r in mp.load_wal()]
+        assert len(wal_counters) == n_threads * per_thread
+        assert wal_counters == sorted(wal_counters)
+        mp.close()
+
 
 class TestGetAfterWait:
     def test_spurious_wakeup_does_not_return_empty(self):
@@ -233,6 +259,105 @@ class TestGetAfterWait:
             t.join(2)
         assert out == []
         assert 0.25 <= dt < 5.0
+
+
+class TestGossipCursorConsistency:
+    def test_mid_scan_admissions_withheld_never_skipped(self):
+        """The gossip reactor advances its cursor to the max returned
+        counter, so `get_after` must never return counter N while an
+        unreturned counter < N exists. Pre-fix, the lane-by-lane scan
+        could do exactly that: a tx admitted into an already-scanned
+        lane was masked by a higher-counter tx in a later lane, and the
+        cursor skipped it forever (the tx was never gossiped). The
+        counter snapshot withholds BOTH mid-scan admissions until the
+        next scan."""
+        mp, _ = _mempool(lanes=4, ingress_batch=False)
+        mp.check_tx(b"seed=1")
+        cursor = max(c for c, _ in mp.get_after(0))
+
+        def tx_for_lane(idx, tag):
+            for i in range(100_000):
+                tx = b"%s-%d=1" % (tag, i)
+                if mp._lane_for(tx) is mp._lanes[idx]:
+                    return tx
+            raise AssertionError("no payload found for lane")
+
+        early_lane_tx = tx_for_lane(0, b"early")  # lane scanned pre-pause
+        late_lane_tx = tx_for_lane(3, b"late")  # lane scanned post-pause
+
+        mid_scan = threading.Event()
+        resume = threading.Event()
+        state = {"armed": True}
+        real_lanes = mp._lanes
+
+        class PausingLanes(list):
+            """Pauses the FIRST iteration (the scan under test) after
+            yielding lane 0; every other iteration is pass-through."""
+
+            def __iter__(self):
+                it = list.__iter__(self)
+                if not state["armed"]:
+                    return it
+                state["armed"] = False
+
+                def gen():
+                    yield next(it)
+                    mid_scan.set()
+                    resume.wait(5)
+                    yield from it
+
+                return gen()
+
+        mp._lanes = PausingLanes(real_lanes)
+        try:
+            got = []
+            t = threading.Thread(
+                target=lambda: got.extend(mp.get_after(cursor)), daemon=True
+            )
+            t.start()
+            assert mid_scan.wait(5)
+            # admitted while the scan sits between lanes: "early" lands
+            # on the lane already walked, "late" on one still to come
+            assert mp.check_tx(early_lane_tx).is_ok
+            assert mp.check_tx(late_lane_tx).is_ok
+            resume.set()
+            t.join(5)
+            assert not t.is_alive()
+        finally:
+            mp._lanes = real_lanes
+        # neither counter is returned (both post-snapshot) — returning
+        # only the late one would advance the cursor past the early one
+        assert got == []
+        # the next scan sees both, in counter order, with no gap
+        after = mp.get_after(cursor)
+        assert [tx for _, tx in after] == [early_lane_tx, late_lane_tx]
+        assert [c for c, _ in after] == [cursor + 1, cursor + 2]
+        mp.close()
+
+
+class TestSignedTxsOptOut:
+    # >= 98 bytes and starts with the envelope magic, but is NOT a real
+    # envelope — an app payload colliding with the reserved prefix
+    COLLIDER = b"\xed\x01" + b"x" * 96
+
+    def test_reserved_prefix_rejected_by_default(self):
+        mp, _ = _mempool(lanes=2, ingress_batch=False)
+        assert mp.check_tx(self.COLLIDER).code == CodeType.UNAUTHORIZED
+        mp.close()
+
+    def test_constructor_opt_out_restores_pass_through(self):
+        mp, _ = _mempool(lanes=2, ingress_batch=False, signed_txs=False)
+        assert mp.check_tx(self.COLLIDER).is_ok
+        assert mp.size() == 1
+        mp.close()
+
+    def test_env_opt_out_covers_batched_path(self, monkeypatch):
+        monkeypatch.setenv("TENDERMINT_TPU_SIGNED_TXS", "0")
+        v = _coalescing()
+        mp, _ = _mempool(lanes=2, ingress_batch=True, verifier=v)
+        assert mp.check_tx(self.COLLIDER).is_ok
+        mp.close()
+        v.close()
 
 
 def _coalescing(cache_size=4096, window_s=0.001):
@@ -415,6 +540,41 @@ class TestIngressBatcher:
         assert fam.value["count"] >= before + 2
         mp.close()
         v.close()
+
+    def test_flush_invalidates_inflight_ingress_admissions(self):
+        """unsafe_flush_mempool must also cover txs sitting in ingress
+        windows: pre-fix a tx queued for admission when the operator
+        flushed re-entered the pool right after the flush."""
+        v = _coalescing()
+        mp, _ = _mempool(
+            lanes=4, ingress_batch=True, verifier=v, ingress_window_s=5.0
+        )
+        adm = mp.check_tx_async(b"inflight=1")
+        mp.flush()  # tx still queued (5 s window, no barrier yet)
+        res = mp._ingress.wait(adm)  # barrier-flush and join NOW
+        assert res.code == CodeType.INTERNAL_ERROR
+        assert mp.size() == 0
+        # caches were reset by the flush: the same tx is re-offerable
+        assert mp.check_tx(b"inflight=1").is_ok
+        assert mp.size() == 1
+        mp.close()
+        v.close()
+
+    def test_close_drains_windows_enqueued_behind_stop(self):
+        """A flusher stuck past close()'s join timeout can enqueue its
+        window AFTER the _STOP sentinel; the joiner exits without
+        resolving it and _Admission.wait() has no timeout — close()'s
+        drain must resolve the batch so no blocked caller hangs."""
+        from tendermint_tpu.mempool.ingress import IngressBatcher, _Admission
+
+        mp, _ = _mempool(lanes=2, ingress_batch=False)
+        b = IngressBatcher(mp)
+        adm = _Admission(b"late=1", None, None, time.time(), None)
+        b._join_q.put((None, [adm], []))  # a window the joiner never saw
+        b.close()
+        assert adm.event.is_set()
+        assert adm.result.code == CodeType.INTERNAL_ERROR
+        mp.close()
 
     def test_close_resolves_queued_admissions(self):
         """A closing pool must not wedge blocked callers: queued
