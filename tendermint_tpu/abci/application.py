@@ -32,7 +32,12 @@ class Application:
     def init_chain(self, validators: list[Validator]) -> None:
         pass
 
-    def begin_block(self, block_hash: bytes, header) -> None:
+    def begin_block(self, block_hash: bytes, header, evidence=()) -> None:
+        """`evidence` is the block's committed misbehavior proofs
+        (`types/evidence.py` DuplicateVoteEvidence — the reference's
+        ByzantineValidators); apps that slash override and inspect it.
+        Legacy 2-arg overrides keep working: the client only passes the
+        evidence kwarg to apps whose signature accepts it."""
         pass
 
     def deliver_tx(self, tx: bytes) -> Result:

@@ -61,14 +61,41 @@ class AppConnMempool(_LocalClient):
         pass
 
 
+def _accepts_evidence(begin_block) -> bool:
+    """True when an app's begin_block takes the evidence argument —
+    legacy 2-arg overrides predate the evidence pipeline and must keep
+    working without a TypeError probe on the hot path."""
+    import inspect
+
+    try:
+        params = inspect.signature(begin_block).parameters
+    except (TypeError, ValueError):
+        return True  # exotic callables: assume the current interface
+    if any(
+        p.kind is inspect.Parameter.VAR_POSITIONAL
+        or p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in params.values()
+    ):
+        return True
+    return "evidence" in params
+
+
 class AppConnConsensus(_LocalClient):
     def init_chain_sync(self, validators: list[Validator]) -> None:
         with self._lock:
             self._app.init_chain(validators)
 
-    def begin_block_sync(self, block_hash: bytes, header) -> None:
+    def begin_block_sync(self, block_hash: bytes, header, evidence=()) -> None:
+        accepts = getattr(self, "_bb_accepts_evidence", None)
+        if accepts is None:
+            accepts = self._bb_accepts_evidence = _accepts_evidence(
+                self._app.begin_block
+            )
         with self._lock:
-            self._app.begin_block(block_hash, header)
+            if accepts:
+                self._app.begin_block(block_hash, header, evidence=evidence)
+            else:
+                self._app.begin_block(block_hash, header)
 
     def deliver_tx_async(self, tx: bytes, cb: Callable[[Result], None] | None = None) -> Result:
         with self._lock:

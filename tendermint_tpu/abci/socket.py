@@ -136,11 +136,21 @@ def handle_abci_request(app: Application, lock: threading.Lock, req: bytes) -> b
         elif tag == _MSG_DELIVER_TX:
             w.raw(app.deliver_tx(r.bytes()).encode())
         elif tag == _MSG_BEGIN_BLOCK:
+            from tendermint_tpu.abci.client import _accepts_evidence
             from tendermint_tpu.types.block import Header
+            from tendermint_tpu.types.evidence import decode_evidence
 
             block_hash = r.bytes()
             header = Header.decode_from(Reader(r.bytes()))
-            app.begin_block(block_hash, header)
+            # trailing optional evidence section (absent from legacy
+            # clients' requests — and hidden from legacy 2-arg apps)
+            evidence = []
+            if not r.done():
+                evidence = [decode_evidence(r.bytes()) for _ in range(r.uvarint())]
+            if _accepts_evidence(app.begin_block):
+                app.begin_block(block_hash, header, evidence=evidence)
+            else:
+                app.begin_block(block_hash, header)
         elif tag == _MSG_END_BLOCK:
             _enc_validators(w, app.end_block(r.uvarint()))
         elif tag == _MSG_COMMIT:
@@ -258,14 +268,18 @@ class _RemoteConsensus:
             _enc_validators(Writer().uvarint(_MSG_INIT_CHAIN), list(validators)).build()
         )
 
-    def begin_block_sync(self, block_hash: bytes, header) -> None:
-        self._conn.call(
+    def begin_block_sync(self, block_hash: bytes, header, evidence=()) -> None:
+        w = (
             Writer()
             .uvarint(_MSG_BEGIN_BLOCK)
             .bytes(block_hash)
             .bytes(header.encode())
-            .build()
         )
+        if evidence:
+            w.uvarint(len(evidence))
+            for ev in evidence:
+                w.bytes(ev.encode())
+        self._conn.call(w.build())
 
     def deliver_tx_async(self, tx: bytes, cb=None) -> Result:
         res = _read_result(

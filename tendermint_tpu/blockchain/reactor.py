@@ -494,9 +494,16 @@ class BlockchainReactor(Reactor):
 
     def _redo(self, height: int) -> None:
         """Bad block/commit: drop the chain suffix and the peer that
-        served it (reference `RedoRequest` + peer eviction)."""
+        served it (reference `RedoRequest` + peer eviction). A block
+        whose commit fails verification cannot be produced honestly —
+        debit the server's misbehavior score so a lying fast-sync peer
+        gets banned, not just disconnected-and-redialed."""
         bad_peer = self.pool.redo(height)
         if bad_peer:
+            if self.switch is not None:
+                self.switch.report_misbehavior(
+                    bad_peer, "forged_block", detail=f"height {height}"
+                )
             self._drop_peer(bad_peer, "bad fast-sync block")
 
     def _drop_peer(self, peer_id: str, reason: str) -> None:

@@ -144,6 +144,9 @@ class Config:
     def wal_path(self) -> str:
         return os.path.join(self.home, self.base.db_dir, "cs.wal")
 
+    def evidence_wal_path(self) -> str:
+        return os.path.join(self.home, self.base.db_dir, "evidence.wal")
+
     def mempool_wal_path(self) -> str:
         return os.path.join(self.home, self.mempool.wal_dir)
 

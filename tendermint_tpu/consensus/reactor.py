@@ -466,6 +466,10 @@ class ConsensusReactor(Reactor):
 
     def on_start(self) -> None:
         self._running = True
+        # adversarial vote input (forged sigs, bogus validator claims)
+        # surfaces inside the consensus loop, not here — route it back
+        # to the switch's misbehavior scorer by peer id
+        self.cs.on_peer_misbehavior = self._report_peer_misbehavior
         es = self.cs.event_switch
         es.add_listener("reactor", ev.EVENT_NEW_ROUND_STEP, self._on_new_round_step)
         es.add_listener("reactor", ev.EVENT_VOTE, self._on_vote_event)
@@ -482,6 +486,10 @@ class ConsensusReactor(Reactor):
         self._running = False
         self.cs.event_switch.remove_listener("reactor")
         self.cs.stop()
+
+    def _report_peer_misbehavior(self, peer_id: str, kind: str, detail: str = "") -> None:
+        if self.switch is not None and peer_id:
+            self.switch.report_misbehavior(peer_id, kind, detail=detail)
 
     def switch_to_consensus(self, state) -> None:
         """Fast-sync caught up: adopt the synced state and start the

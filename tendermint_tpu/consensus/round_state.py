@@ -164,7 +164,18 @@ class HeightVoteSet:
         return -1, None
 
     def set_peer_maj23(self, round_: int, type_: int, peer_id: str, block_id: BlockID) -> None:
+        """Record a peer's +2/3 claim. Future-round claims ride the SAME
+        per-peer catchup allowance as votes (at most 2 rounds above
+        round+1 per peer) — without the gate a flooding peer could mint
+        a fresh VoteSet pair per claimed round, unbounded per-height
+        state (`consensus/reactor.py` Maj23 receive path)."""
         with self._lock:
+            if round_ > self.round + 1:
+                rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+                if round_ not in rounds:
+                    if len(rounds) >= 2:
+                        return  # claim flood: refuse the allocation
+                    rounds.append(round_)
             self._add_round(round_)
             vs = self._get(round_, type_)
         if vs is not None:

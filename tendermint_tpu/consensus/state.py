@@ -44,6 +44,11 @@ from tendermint_tpu.types.block_id import BlockID
 from tendermint_tpu.types.heartbeat import Heartbeat
 from tendermint_tpu.types.errors import (
     ErrDoubleSign,
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
     FatalConsensusError,
     ValidationError,
 )
@@ -87,6 +92,7 @@ class ConsensusState:
         verifier=None,
         tx_indexer=None,
         hasher=None,
+        evidence_pool=None,
     ) -> None:
         self.config = config
         self.app_conn = app_conn
@@ -96,6 +102,22 @@ class ConsensusState:
         self.event_switch = event_switch if event_switch is not None else ev.EventSwitch()
         self.verifier = verifier
         self.tx_indexer = tx_indexer
+        # Byzantine accountability: ErrVoteConflictingVotes sites feed
+        # DuplicateVoteEvidence here; proposals reap it; commits retire
+        # it. None = detection still logs/records, proof is dropped
+        # (the pre-evidence behavior).
+        self.evidence_pool = evidence_pool
+        if evidence_pool is not None:
+            evidence_pool.chain_id = state.chain_id
+            if evidence_pool.verifier is None:
+                evidence_pool.verifier = verifier
+            evidence_pool.val_set_fn = self._evidence_val_set
+            evidence_pool.best_height_fn = lambda: self.height
+        # reactor-wired hook: fn(peer_id, kind, detail) — classified
+        # adversarial vote input debits the sending peer's p2p
+        # misbehavior score (equivocation is VALIDATOR fault and goes to
+        # the evidence pool instead; the relaying peer did nothing wrong)
+        self.on_peer_misbehavior = None
         # TreeHasher for proposal-block data_hash/part-set builds; None = host
         # merkle (reference SimpleHash call sites `types/block.go:177`).
         self.hasher = hasher
@@ -906,6 +928,18 @@ class ConsensusState:
         else:
             return None  # can't propose without the last commit
         txs = self.mempool.reap(self.config.max_block_size_txs)
+        # commit pending misbehavior proofs alongside the txs (reference
+        # `createProposalBlock` reaps the evidence pool); expired proofs
+        # would fail every honest validator's validate_block, so filter
+        # here rather than waste the proposal
+        evidence = []
+        if self.evidence_pool is not None:
+            params = self.state.consensus_params.evidence
+            evidence = [
+                e
+                for e in self.evidence_pool.pending_evidence(params.max_evidence)
+                if self.height - e.height <= params.max_age
+            ]
         block = Block.make_block(
             height=self.height,
             chain_id=self.state.chain_id,
@@ -916,6 +950,7 @@ class ConsensusState:
             validators_hash=self.state.validators.hash(),
             app_hash=self.state.app_hash,
             hasher=self.hasher,
+            evidence=evidence,
         )
         return block, block.make_part_set(
             self.state.consensus_params.block_gossip.block_part_size_bytes,
@@ -1202,6 +1237,9 @@ class ConsensusState:
             )
 
             fail_point()  # applied, before round-state reset
+            if self.evidence_pool is not None:
+                # retire committed proofs + prune expired stragglers
+                self.evidence_pool.update(height, list(block.evidence))
             self._observe_phase(None)  # closes the "commit" span
             height_wall = time_mod.monotonic() - self._height_started
             _metrics.CONSENSUS_HEIGHT_SECONDS.observe(height_wall)
@@ -1278,8 +1316,101 @@ class ConsensusState:
 
     # ---------------------------------------------------------------- votes
 
+    def _evidence_val_set(self, height: int):
+        """Validator set evidence at `height` must verify against. Only
+        the live and previous sets are retained in memory; older
+        evidence verifies best-effort against the current set (a
+        validator absent from both is unprovable here and the evidence
+        is rejected — the max-age window bounds how far back proofs can
+        reach anyway)."""
+        if height == self.height - 1 and self.state.last_validators is not None:
+            if self.state.last_validators.size():
+                return self.state.last_validators
+        return self.validators
+
+    def _report_misbehavior(self, peer_id: str, kind: str, detail: str = "") -> None:
+        cb = self.on_peer_misbehavior
+        if cb is not None and peer_id:
+            try:
+                cb(peer_id, kind, detail)
+            except Exception:
+                pass  # scoring must never hurt consensus
+
+    def _found_conflicting_votes(
+        self, err: ErrVoteConflictingVotes, peer_id: str
+    ) -> None:
+        """An equivocation surfaced (reference `tryAddVote`'s
+        ErrVoteConflictingVotes branch — which the reference, like the
+        seed here, used to throw away). Both votes carry verified
+        signatures by construction, so the pair IS the proof: build
+        DuplicateVoteEvidence and feed the pool (which WALs, gossips on
+        0x38, and surfaces it to the next proposal)."""
+        from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+        evidence = DuplicateVoteEvidence.make(err.vote_a, err.vote_b)
+        FLIGHT.record(
+            "evidence_detected",
+            validator=evidence.address.hex()[:12],
+            height=evidence.height,
+            round=evidence.vote_a.round,
+            type=evidence.vote_a.type,
+            peer=peer_id[:12],
+        )
+        _log_mod.kv(
+            _log_mod.logger("consensus"),
+            _logging.WARNING,
+            "conflicting votes detected",
+            validator=evidence.address.hex()[:12],
+            height=evidence.height,
+            round=evidence.vote_a.round,
+        )
+        if self.evidence_pool is None:
+            return
+        try:
+            self.evidence_pool.add_evidence(
+                evidence, val_set=self._evidence_val_set(evidence.height)
+            )
+        except ValidationError:
+            # locally detected pairs verified on entry; a failure here
+            # means the offender left the retained valsets — drop it
+            pass
+
     def _handle_vote(self, vote: Vote, peer_id: str, preverified: bool = False) -> None:
         """Reference `tryAddVote/addVote :1318-1453`."""
+        try:
+            self._handle_vote_inner(vote, peer_id, preverified)
+        except ErrVoteConflictingVotes as e:
+            self._found_conflicting_votes(e, peer_id)
+            # The conflict can surface AFTER the tally moved: a vote for
+            # a peer-maj23-tracked block is counted first, raises second
+            # (`VoteSet._add_verified_vote`), and that count may have
+            # JUST tipped +2/3. Swallowing the error without running the
+            # post-add transitions wedged the height permanently (no
+            # later vote re-triggers them — duplicates don't re-add).
+            # The transition handlers are guarded + idempotent, so run
+            # them unconditionally for current-height votes.
+            if vote.height == self.height:
+                if vote.type == VOTE_TYPE_PREVOTE:
+                    self._on_prevote_added(vote)
+                elif vote.type == VOTE_TYPE_PRECOMMIT:
+                    self._on_precommit_added(vote)
+        except (
+            ErrVoteInvalidSignature,
+            ErrVoteNonDeterministicSignature,
+        ) as e:
+            # forged/malleated signature: adversarial input, not noise —
+            # debit the sender (a garbage-sig flood bans it) and move on
+            # without letting the error reach the loop's traceback dump
+            self._report_misbehavior(peer_id, "bad_sig", str(e))
+        except (
+            ErrVoteInvalidValidatorAddress,
+            ErrVoteInvalidValidatorIndex,
+        ) as e:
+            self._report_misbehavior(peer_id, "bad_vote", str(e))
+
+    def _handle_vote_inner(
+        self, vote: Vote, peer_id: str, preverified: bool = False
+    ) -> None:
         # LastCommit catchup: precommit for height-1 while in NewHeight step
         if vote.height + 1 == self.height:
             if (

@@ -168,6 +168,28 @@ def _decode_point(data: bytes) -> tuple | None:
     return (x, y, 1, x * y % P)
 
 
+def is_small_order(pub: bytes) -> bool:
+    """True when `pub` is NOT a safe signing identity: undecodable,
+    non-canonical (y >= p), or a torsion point of order dividing 8.
+
+    Small-order keys are the classic ed25519 adversarial input: for the
+    all-zero key (order 4), h = H(R, A, M) mod 4 lands on 0 for ~1/4 of
+    messages, making (zero key, zero sig) "verify" against arbitrary
+    payloads through cofactorless library verifies — a keyless forgery.
+    Every verify path in this package screens signer keys through this
+    check (found by the Byzantine garbage-sig flood harness, ISSUE 9)."""
+    if len(pub) != 32:
+        return True
+    if (int.from_bytes(pub, "little") & ((1 << 255) - 1)) >= P:
+        return True  # non-canonical encoding
+    pt = _decode_point(pub)
+    if pt is None:
+        return True
+    q = _pt_double(_pt_double(_pt_double(pt)))  # [8]A
+    # identity in extended coordinates: X/Z == 0 and Y/Z == 1
+    return q[0] % P == 0 and (q[1] - q[2]) % P == 0
+
+
 def _sha512_int(*chunks: bytes) -> int:
     h = hashlib.sha512()
     for c in chunks:
@@ -208,6 +230,8 @@ def verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
     and store reloads; repeats must not re-pay ~2 ms each.
     """
     if len(sig) != 64:
+        return False
+    if is_small_order(pub):  # keyless-forgery screen (see is_small_order)
         return False
     a_pt = _decode_point(pub)
     if a_pt is None:

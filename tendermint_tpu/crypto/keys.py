@@ -34,6 +34,26 @@ PRIVKEY_SEED_LEN = 32
 PUBKEY_LEN = 32
 SIGNATURE_LEN = 64
 
+# pubkey -> unsafe? memo (keys repeat heavily: valset members, signed-tx
+# senders); bounded so an attacker cycling fresh garbage keys cannot
+# grow it without limit
+_UNSAFE_PK_CACHE: dict[bytes, bool] = {}
+_UNSAFE_PK_CACHE_MAX = 8192
+
+
+def _unsafe_pubkey(pub: bytes) -> bool:
+    """Small-order / non-canonical screen (ed25519_ref.is_small_order),
+    memoized per key."""
+    v = _UNSAFE_PK_CACHE.get(pub)
+    if v is None:
+        from tendermint_tpu.crypto import ed25519_ref
+
+        v = ed25519_ref.is_small_order(pub)
+        if len(_UNSAFE_PK_CACHE) >= _UNSAFE_PK_CACHE_MAX:
+            _UNSAFE_PK_CACHE.clear()
+        _UNSAFE_PK_CACHE[pub] = v
+    return v
+
 
 @dataclass(frozen=True)
 class PubKey:
@@ -48,6 +68,12 @@ class PubKey:
     def verify(self, msg: bytes, signature: bytes) -> bool:
         """One-at-a-time host verification (the slow reference path)."""
         if len(signature) != SIGNATURE_LEN:
+            return False
+        # Small-order / non-canonical keys are keyless-forgery inputs
+        # (the zero key "verifies" ~1/4 of messages through library
+        # cofactorless verifies) — screened HERE so every consumer of
+        # the host path is covered, library or reference backend alike.
+        if _unsafe_pubkey(self.data):
             return False
         if not HAVE_CRYPTOGRAPHY:
             from tendermint_tpu.crypto import ed25519_ref

@@ -349,7 +349,15 @@ class IngressBatcher:
         """The window's signature verdicts: from the coalesced launch
         when it resolved, else one host pass over the window — the
         per-window degradation rung below the verifier's own breaker
-        ladder."""
+        ladder.
+
+        AUDIT (docs/BYZANTINE.md): a forged signature is a FALSE VERDICT
+        in the mask, never an exception — so a garbage-sig flood through
+        this path cannot record breaker failures and cannot DoS the
+        device fast path into host crypto. Only dispatch-layer faults
+        (the `except` below / a raising launch) count against the
+        breaker, inside the verifier stack itself. Pinned by
+        tests/test_byzantine.py::TestIngressFloodRecovery."""
         if not signed:
             return []
         if handle is not None:

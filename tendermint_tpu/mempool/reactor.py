@@ -72,8 +72,24 @@ class MempoolReactor(Reactor):
         # window: the tx joins the next ingress batch and this thread
         # goes back to draining frames (the sender's trace context is
         # ambient here and captured at submit).
+        #
+        # Adversarial-input hook: a FORGED envelope signature from a
+        # gossiping peer is an attack on the verify spine (each one buys
+        # a device lane) — debit the sender's misbehavior score at the
+        # window join so a garbage-sig flooder gets banned while honest
+        # traffic keeps flowing. App rejections stay unpenalized.
+        cb = None
+        if self.switch is not None:
+            from tendermint_tpu.abci.types import CodeType
+
+            switch, peer_id = self.switch, peer.id
+
+            def cb(res, _switch=switch, _peer_id=peer_id):
+                if res.code == CodeType.UNAUTHORIZED:
+                    _switch.report_misbehavior(_peer_id, "bad_sig", detail="gossiped tx")
+
         submit = getattr(self.mempool, "check_tx_async", None)
-        (submit or self.mempool.check_tx)(tx)
+        (submit or self.mempool.check_tx)(tx, cb)
 
     def _broadcast_routine(self, peer: Peer) -> None:
         """Reference `broadcastTxRoutine :114-152`. The cursor is the

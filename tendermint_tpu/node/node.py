@@ -183,6 +183,16 @@ class Node:
         if hasattr(verifier, "warm_kernels"):
             verifier.warm_kernels()
 
+        # evidence pool: WAL-backed so pending proofs survive a crash;
+        # consensus wires the validator-set/height resolvers in its ctor
+        from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
+
+        self.evidence_pool = EvidencePool(
+            wal_path=cfg.evidence_wal_path(),
+            params=self.state.consensus_params.evidence,
+            verifier=verifier,
+            chain_id=self.genesis.chain_id,
+        )
         self.consensus = ConsensusState(
             config=cfg.consensus,
             state=self.state,
@@ -196,7 +206,9 @@ class Node:
             verifier=verifier,
             tx_indexer=self.tx_indexer,
             hasher=hasher,
+            evidence_pool=self.evidence_pool,
         )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.consensus_reactor = ConsensusReactor(self.consensus, fast_sync=fast_sync)
         self.blockchain_reactor = BlockchainReactor(
             state=self.state,
@@ -291,6 +303,7 @@ class Node:
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
+        self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("statesync", self.statesync_reactor)
         self.pex_reactor = None
         if cfg.p2p.pex:
@@ -548,6 +561,7 @@ class Node:
             self.listener.stop()
         self.switch.stop()
         self.mempool.close()
+        self.evidence_pool.close()
         self.app_conns.close()
         if getattr(self, "_span_log", None) is not None:
             from tendermint_tpu.telemetry import TRACER

@@ -11,6 +11,7 @@ collectives) and `ops/` (batch kernels).
 
 from tendermint_tpu.p2p.connection import ChannelDescriptor, MConnection
 from tendermint_tpu.p2p.peer import NodeInfo, Peer
+from tendermint_tpu.p2p.score import PeerMisbehavior, PeerScorer
 from tendermint_tpu.p2p.switch import (
     Reactor,
     Switch,
@@ -24,6 +25,8 @@ __all__ = [
     "MConnection",
     "NodeInfo",
     "Peer",
+    "PeerMisbehavior",
+    "PeerScorer",
     "Reactor",
     "Switch",
     "connect_switches",

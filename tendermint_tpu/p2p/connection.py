@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass
 
 from tendermint_tpu.codec.binary import Reader, Writer
+from tendermint_tpu.p2p.score import PeerMisbehavior
 from tendermint_tpu.p2p.transport import Endpoint, EndpointClosed
 from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
@@ -56,6 +57,12 @@ def parse_frame(frame: bytes) -> tuple[int, bytes, TraceContext | None]:
         except Exception:
             _metrics.TRACE_DROPPED.inc()
     return chan_id, payload, ctx
+
+# Hard per-frame size cap: whole blocks ride fast-sync responses (21 MB
+# block cap + framing overhead), nothing legitimate exceeds this. An
+# oversize frame is adversarial by definition — the sender is dropped
+# (and scored) before the payload is even parsed.
+MAX_FRAME_SIZE = 32 * 1024 * 1024
 
 # Internal keepalive channel (reference sends dedicated packetTypePing/
 # packetTypePong frames, `p2p/connection.go:312-345`; here they ride a
@@ -263,7 +270,24 @@ class MConnection:
                 # inbound flow control: delay further reads once over
                 # the cap (the sender blocks on TCP backpressure)
                 self.recv_monitor.throttle()
-                chan_id, payload, ctx = parse_frame(frame)
+                # Adversarial-input boundary: a malformed, truncated, or
+                # oversized frame is THIS PEER's fault — surface a typed
+                # PeerMisbehavior through on_error (the switch debits
+                # its score and drops only this peer) instead of letting
+                # the parse error masquerade as a transport failure or,
+                # worse, crash the reader thread.
+                if len(frame) > MAX_FRAME_SIZE:
+                    self._die(
+                        PeerMisbehavior(
+                            "oversize_frame", f"{len(frame)} bytes"
+                        )
+                    )
+                    return
+                try:
+                    chan_id, payload, ctx = parse_frame(frame)
+                except Exception as e:
+                    self._die(PeerMisbehavior("bad_frame", str(e)))
+                    return
                 self._last_recv = time.monotonic()
                 if chan_id == CTRL_CHANNEL:
                     # keepalive (reference recvRoutine ping/pong handling

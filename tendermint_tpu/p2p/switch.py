@@ -15,7 +15,9 @@ from typing import Callable
 
 from tendermint_tpu.p2p.connection import ChannelDescriptor
 from tendermint_tpu.p2p.peer import NodeInfo, Peer
+from tendermint_tpu.p2p.score import PeerMisbehavior, PeerScorer
 from tendermint_tpu.p2p.transport import Endpoint, pipe_pair
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.utils.log import kv, logger
 
 
@@ -79,6 +81,11 @@ class Switch:
         # registration. remote_addr is the SOCKET's remote address ("" on
         # in-memory transports) — never the peer's self-reported one.
         self.peer_filter = None
+        # adversarial-input defense: per-peer misbehavior scores + bans
+        # (p2p/score.py). Reactors and the connection layer report
+        # offenses through report_misbehavior; crossing the threshold
+        # disconnects AND refuses reconnection until the ban decays.
+        self.scorer = PeerScorer()
 
     @property
     def node_info(self) -> NodeInfo:
@@ -167,6 +174,9 @@ class Switch:
         if reason is not None:
             endpoint.close()
             raise ValueError(f"incompatible peer: {reason}")
+        if self.scorer.is_banned(remote_info.node_id):
+            endpoint.close()
+            raise ValueError(f"peer banned: {remote_info.node_id[:12]}")
         if self.peer_filter is not None:
             reason = self.peer_filter(
                 remote_info, getattr(endpoint, "remote_addr", "")
@@ -233,7 +243,49 @@ class Switch:
         messages; the peer is dropped everywhere."""
         self.stop_peer(peer, reason)
 
+    # -- misbehavior ---------------------------------------------------------
+
+    def report_misbehavior(
+        self, peer, kind: str, detail: str = "", weight: int | None = None
+    ) -> None:
+        """Debit one classified offense against a peer (`Peer` or node
+        id). Crossing the ban threshold bans + disconnects it; below
+        threshold the peer stays connected (score decay forgives honest
+        noise). Severe kinds carry weights that ban in 1-2 offenses
+        (p2p/score.py MISBEHAVIOR_WEIGHTS)."""
+        peer_obj = peer if isinstance(peer, Peer) else None
+        peer_id = peer_obj.id if peer_obj is not None else str(peer or "")
+        if not peer_id:
+            return  # internal / self-originated input: never self-ban
+        _metrics.PEER_MISBEHAVIOR.labels(kind=kind).inc()
+        kv(
+            logger("p2p"),
+            logging.WARNING,
+            "peer misbehavior",
+            id=peer_id[:12],
+            kind=kind,
+            score=round(self.scorer.score(peer_id), 1),
+            detail=detail[:80],
+        )
+        if self.scorer.debit(peer_id, kind, weight=weight):
+            self.ban_peer(peer_id, reason=f"misbehavior threshold ({kind})")
+
+    def ban_peer(self, peer_id: str, reason: str = "banned") -> None:
+        """Ban + disconnect by node id (idempotent)."""
+        self.scorer.ban(peer_id)
+        _metrics.PEER_BANS.inc()
+        kv(logger("p2p"), logging.WARNING, "peer banned", id=peer_id[:12], reason=reason)
+        with self._mtx:
+            peer = self._peers.get(peer_id)
+        if peer is not None:
+            self.stop_peer(peer, reason)
+
     def _on_peer_error(self, peer: Peer, exc) -> None:
+        if isinstance(exc, PeerMisbehavior):
+            # connection-layer offense (bad/oversize frame): debit the
+            # score BEFORE the drop so repeat offenders get banned and
+            # cannot cycle reconnect->garbage->disconnect forever
+            self.report_misbehavior(peer, exc.kind, detail=exc.detail)
         self.stop_peer(peer, exc)
 
     def _dispatch(self, chan_id: int, peer: Peer, payload: bytes) -> None:
@@ -243,7 +295,10 @@ class Switch:
         try:
             reactor.receive(chan_id, peer, payload)
         except Exception as e:
-            # a reactor exploding on a message is peer-fault by default
+            # a reactor exploding on a message is peer-fault by default:
+            # the frame parsed but its payload didn't survive the
+            # reactor's decode/validate — score it, then drop the peer
+            self.report_misbehavior(peer, "bad_msg", detail=str(e))
             self.stop_peer_for_error(peer, e)
 
     # -- broadcast ---------------------------------------------------------

@@ -271,7 +271,16 @@ def make_routes(node) -> dict:
             "breakers": breakers,
             # per-peer view the exported gauges deliberately aggregate
             # (peer-id label cardinality — docs/OBSERVABILITY.md)
-            "p2p": {"send_queues": node.switch.send_queue_depths()},
+            "p2p": {
+                "send_queues": node.switch.send_queue_depths(),
+                # misbehavior scores + live bans (docs/BYZANTINE.md);
+                # absent on stub switches without a scorer
+                "misbehavior": (
+                    node.switch.scorer.snapshot()
+                    if getattr(node.switch, "scorer", None) is not None
+                    else {}
+                ),
+            },
         }
         if int(flight) > 0:
             from tendermint_tpu.telemetry.flightrec import FLIGHT

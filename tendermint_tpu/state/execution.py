@@ -46,6 +46,7 @@ def validate_block(
         raise ValidationError(
             f"wrong chain_id: got {block.header.chain_id}, want {state.chain_id}"
         )
+    _validate_block_evidence(state, block, verifier)
     if block.header.height != state.last_block_height + 1:
         raise ValidationError(
             f"wrong height: got {block.header.height}, want {state.last_block_height + 1}"
@@ -79,6 +80,37 @@ def validate_block(
             )
 
 
+def _validate_block_evidence(state: State, block: Block, verifier) -> None:
+    """Evidence policy + proof checks (reference `VerifyEvidence
+    state/validation.go`): count under ConsensusParams.max_evidence,
+    every proof inside the max-age window, every signature genuine —
+    the whole list as ONE batched verify (2 lanes per proof)."""
+    from tendermint_tpu.types.evidence import verify_evidence_batch
+
+    evidence = list(block.evidence)
+    if not evidence:
+        return
+    params = state.consensus_params.evidence
+    if len(evidence) > params.max_evidence:
+        raise ValidationError(
+            f"block carries {len(evidence)} evidence, max {params.max_evidence}"
+        )
+    for ev in evidence:
+        if block.header.height - ev.height > params.max_age:
+            raise ValidationError(
+                f"expired evidence: height {ev.height} at block "
+                f"{block.header.height} (max_age {params.max_age})"
+            )
+        if ev.height > block.header.height:
+            raise ValidationError("evidence from the future")
+    verify_evidence_batch(
+        state.chain_id,
+        evidence,
+        [state.validators, state.last_validators],
+        verifier=verifier,
+    )
+
+
 def exec_block_on_proxy_app(
     app_conn: AppConnConsensus,
     block: Block,
@@ -86,8 +118,12 @@ def exec_block_on_proxy_app(
 ) -> ABCIResponses:
     """BeginBlock, DeliverTx per tx, EndBlock (reference
     `execBlockOnProxyApp state/execution.go:43-118`). Tx results stream
-    to `on_tx_result` (the event bus slot)."""
-    app_conn.begin_block_sync(block.hash(), block.header)
+    to `on_tx_result` (the event bus slot); committed evidence rides
+    BeginBlock so the app can hold equivocators accountable (reference
+    ByzantineValidators in RequestBeginBlock)."""
+    app_conn.begin_block_sync(
+        block.hash(), block.header, evidence=list(block.evidence)
+    )
     responses = ABCIResponses(height=block.header.height)
     for i, tx in enumerate(block.data.txs):
         res = app_conn.deliver_tx_async(bytes(tx))

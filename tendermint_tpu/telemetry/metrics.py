@@ -346,6 +346,46 @@ P2P_SEND_QUEUE_MAX = Gauge(
     "tendermint_p2p_send_queue_max",
     "Deepest single-peer send queue (frames)",
 )
+# Adversarial-input defense (p2p/score.py + Switch.report_misbehavior):
+# `kind` is the fixed offense taxonomy (bad_frame/oversize_frame/
+# bad_msg/bad_sig/bad_vote/forged_block/bad_evidence/flood) — never
+# peer ids (per-peer scores live in the scorer's diagnostics snapshot).
+PEER_MISBEHAVIOR = Counter(
+    "tendermint_p2p_peer_misbehavior_total",
+    "Classified peer offenses debited against misbehavior scores",
+    labelnames=("kind",),
+)
+PEER_BANS = Counter(
+    "tendermint_p2p_peer_bans_total",
+    "Peers banned for crossing the misbehavior threshold",
+)
+
+for _kind in (
+    "bad_frame",
+    "oversize_frame",
+    "bad_msg",
+    "bad_sig",
+    "bad_vote",
+    "forged_block",
+    "bad_evidence",
+    "flood",
+):
+    PEER_MISBEHAVIOR.labels(kind=_kind).inc(0)
+
+# -- evidence -----------------------------------------------------------------
+
+EVIDENCE_POOL_DEPTH = Gauge(
+    "tendermint_evidence_pool_depth",
+    "Verified misbehavior proofs pending commitment into a block",
+)
+EVIDENCE_COMMITTED = Counter(
+    "tendermint_evidence_committed_total",
+    "Evidence retired from the pool by block commitment",
+)
+EVIDENCE_EXPIRED = Counter(
+    "tendermint_evidence_expired_total",
+    "Pending evidence pruned past the ConsensusParams max-age window",
+)
 
 # -- mempool ------------------------------------------------------------------
 #

@@ -1,9 +1,28 @@
-"""In-process chaos testing harnesses (network nemesis + invariants)."""
+"""In-process chaos testing harnesses (network nemesis + invariants,
+Byzantine adversary drivers)."""
 
 from tendermint_tpu.testing.nemesis import (
     InvariantViolation,
     Nemesis,
     NemesisNode,
 )
+from tendermint_tpu.testing.byzantine import (
+    ConflictingProposer,
+    Equivocator,
+    FrameFuzzer,
+    GarbageSigFlooder,
+    LyingFastSyncPeer,
+    wait_evidence_committed,
+)
 
-__all__ = ["InvariantViolation", "Nemesis", "NemesisNode"]
+__all__ = [
+    "ConflictingProposer",
+    "Equivocator",
+    "FrameFuzzer",
+    "GarbageSigFlooder",
+    "InvariantViolation",
+    "LyingFastSyncPeer",
+    "Nemesis",
+    "NemesisNode",
+    "wait_evidence_committed",
+]

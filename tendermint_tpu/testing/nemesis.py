@@ -166,11 +166,19 @@ class NemesisNode:
         from tendermint_tpu.consensus.reactor import ConsensusReactor
         from tendermint_tpu.consensus.state import ConsensusState
         from tendermint_tpu.consensus.ticker import TimeoutTicker
+        from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
         from tendermint_tpu.state.state import load_state
 
         state = load_state(self.state_db)
         self.store = BlockStore(self.store_db)
         self.conns = local_client_creator(self.app)()
+        # evidence WAL survives crash/restart next to the consensus WAL
+        self.evidence_pool = EvidencePool(
+            wal_path=os.path.join(os.path.dirname(self.wal_path), "evidence.wal"),
+            params=state.consensus_params.evidence,
+            verifier=self.verifier,
+            chain_id=self.chain_id,
+        )
         self.cs = ConsensusState(
             config=self.config,
             state=state,
@@ -181,6 +189,7 @@ class NemesisNode:
             ticker=TimeoutTicker(),
             verifier=self.verifier,
             hasher=self.hasher,
+            evidence_pool=self.evidence_pool,
         )
         self.reactor = ConsensusReactor(self.cs)
         self.switch = Switch(
@@ -191,6 +200,7 @@ class NemesisNode:
             )
         )
         self.switch.add_reactor("consensus", self.reactor)
+        self.switch.add_reactor("evidence", EvidenceReactor(self.evidence_pool))
 
     def start(self) -> None:
         self.switch.start()  # reactor.on_start starts the consensus loop
@@ -199,6 +209,7 @@ class NemesisNode:
     def stop(self) -> None:
         if self.running:
             self.switch.stop()
+            self.evidence_pool.close()
             self.running = False
 
     def crash(self) -> None:
@@ -307,6 +318,10 @@ class FullNemesisNode:
     @property
     def cs(self):
         return self.node.consensus
+
+    @property
+    def evidence_pool(self):
+        return self.node.evidence_pool
 
     @property
     def height(self) -> int:
@@ -806,9 +821,29 @@ class Nemesis:
             if all(self.nodes[i].store.height >= height for i in targets):
                 return
             time.sleep(0.05)
+        self._dump_stall_forensics()
         raise TimeoutError(
             f"heights {self.heights()} did not reach {height} in {timeout}s"
         )
+
+    def _dump_stall_forensics(self) -> None:
+        """A progress timeout on an UNpartitioned in-process net usually
+        means one node's consensus thread is wedged or blocked — dump
+        every thread's stack (plus the flight recorder) so the red run
+        carries its own diagnosis, like invariant violations already do."""
+        import faulthandler
+        import sys
+
+        from tendermint_tpu.telemetry.flightrec import FLIGHT
+
+        try:
+            sys.stderr.write(
+                f"nemesis stall: heights={self.heights()} — thread stacks:\n"
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            FLIGHT.dump(reason="nemesis-stall", dir=self.home)
+        except Exception:
+            pass  # forensics must never mask the timeout itself
 
     def wait_progress(
         self,

@@ -30,28 +30,34 @@ class Header:
     data_hash: bytes = b""
     validators_hash: bytes = b""
     app_hash: bytes = b""
+    evidence_hash: bytes = b""
 
     def hash(self) -> bytes:
         """SimpleMerkle of the field map (reference `Header.Hash :173-188`).
-        Returns b"" if validators_hash is unset (header not yet filled)."""
+        Returns b"" if validators_hash is unset (header not yet filled).
+        The evidence commitment only enters the map when evidence is
+        present, so evidence-free headers hash exactly as before the
+        field existed (wire + hash backward compatibility in one rule).
+        """
         if not self.validators_hash:
             return b""
-        return simple_hash_from_map(
-            {
-                "chain_id": encode_string(self.chain_id),
-                "height": encode_uvarint(self.height),
-                "time": encode_uvarint(self.time),
-                "num_txs": encode_uvarint(self.num_txs),
-                "last_block_id": self.last_block_id.encode(),
-                "last_commit": self.last_commit_hash,
-                "data": self.data_hash,
-                "validators": self.validators_hash,
-                "app": self.app_hash,
-            }
-        )
+        kvs = {
+            "chain_id": encode_string(self.chain_id),
+            "height": encode_uvarint(self.height),
+            "time": encode_uvarint(self.time),
+            "num_txs": encode_uvarint(self.num_txs),
+            "last_block_id": self.last_block_id.encode(),
+            "last_commit": self.last_commit_hash,
+            "data": self.data_hash,
+            "validators": self.validators_hash,
+            "app": self.app_hash,
+        }
+        if self.evidence_hash:
+            kvs["evidence"] = self.evidence_hash
+        return simple_hash_from_map(kvs)
 
     def encode(self) -> bytes:
-        return (
+        w = (
             Writer()
             .string(self.chain_id)
             .uvarint(self.height)
@@ -62,12 +68,16 @@ class Header:
             .bytes(self.data_hash)
             .bytes(self.validators_hash)
             .bytes(self.app_hash)
-            .build()
         )
+        # trailing optional field: absent when empty, so evidence-free
+        # headers are byte-identical to the pre-evidence encoding
+        if self.evidence_hash:
+            w.bytes(self.evidence_hash)
+        return w.build()
 
     @classmethod
     def decode_from(cls, r: Reader) -> "Header":
-        return cls(
+        h = cls(
             chain_id=r.string(),
             height=r.uvarint(),
             time=r.svarint(),
@@ -78,6 +88,9 @@ class Header:
             validators_hash=r.bytes(),
             app_hash=r.bytes(),
         )
+        if not r.done():
+            h.evidence_hash = r.bytes()
+        return h
 
 
 @dataclass
@@ -155,6 +168,40 @@ class Commit:
 
 
 @dataclass
+class EvidenceData:
+    """Misbehavior proofs committed in a block (reference
+    `types/block.go` EvidenceData). Hash = Merkle root over the encoded
+    evidence (`Header.evidence_hash`); empty lists hash to b"" so
+    evidence-free blocks are unchanged."""
+
+    evidence: list = field(default_factory=list)
+
+    def hash(self, hasher=None) -> bytes:
+        from tendermint_tpu.types.evidence import evidence_hash
+
+        return evidence_hash(self.evidence, hasher)
+
+    def __len__(self) -> int:
+        return len(self.evidence)
+
+    def __iter__(self):
+        return iter(self.evidence)
+
+    def encode(self) -> bytes:
+        w = Writer().uvarint(len(self.evidence))
+        for ev in self.evidence:
+            w.bytes(ev.encode())
+        return w.build()
+
+    @classmethod
+    def decode_from(cls, r: Reader) -> "EvidenceData":
+        from tendermint_tpu.types.evidence import decode_evidence
+
+        n = r.uvarint()
+        return cls(evidence=[decode_evidence(r.bytes()) for _ in range(n)])
+
+
+@dataclass
 class Data:
     txs: Txs = field(default_factory=Txs)
 
@@ -178,6 +225,7 @@ class Block:
     header: Header
     data: Data
     last_commit: Commit
+    evidence: EvidenceData = field(default_factory=EvidenceData)
 
     @classmethod
     def make_block(
@@ -191,6 +239,7 @@ class Block:
         validators_hash: bytes,
         app_hash: bytes,
         hasher=None,
+        evidence: list | None = None,
     ) -> "Block":
         """Build + fill a proposal block (reference `types/block.go:26-45`)."""
         block = cls(
@@ -205,6 +254,7 @@ class Block:
             ),
             data=Data(txs=txs),
             last_commit=last_commit,
+            evidence=EvidenceData(evidence=list(evidence) if evidence else []),
         )
         block.fill_header(hasher)
         return block
@@ -214,6 +264,8 @@ class Block:
             self.header.last_commit_hash = self.last_commit.hash()
         if not self.header.data_hash:
             self.header.data_hash = self.data.hash(hasher)
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = self.evidence.hash(hasher)
 
     def hash(self) -> bytes:
         return self.header.hash()
@@ -237,15 +289,24 @@ class Block:
             raise ValidationError("last_commit_hash mismatch")
         if self.header.data_hash != self.data.hash(hasher):
             raise ValidationError("data_hash mismatch")
+        if self.header.evidence_hash != self.evidence.hash(hasher):
+            raise ValidationError("evidence_hash mismatch")
+        for ev in self.evidence:
+            ev.validate_basic()
 
     def encode(self) -> bytes:
-        return (
+        w = (
             Writer()
             .bytes(self.header.encode())
             .bytes(self.data.encode())
             .bytes(self.last_commit.encode())
-            .build()
         )
+        # trailing optional section (mirrors Header.evidence_hash):
+        # evidence-free blocks keep the legacy 3-field wire form, so
+        # stored history and older peers decode unchanged
+        if len(self.evidence):
+            w.bytes(self.evidence.encode())
+        return w.build()
 
     @classmethod
     def decode(cls, data: bytes) -> "Block":
@@ -253,8 +314,13 @@ class Block:
         header = Header.decode_from(Reader(r.bytes()))
         d = Data.decode_from(Reader(r.bytes()))
         lc = Commit.decode_from(Reader(r.bytes()))
+        evidence = (
+            EvidenceData.decode_from(Reader(r.bytes()))
+            if not r.done()
+            else EvidenceData()
+        )
         r.expect_done()
-        return cls(header=header, data=d, last_commit=lc)
+        return cls(header=header, data=d, last_commit=lc, evidence=evidence)
 
     def block_id(self, part_size: int = DEFAULT_PART_SIZE) -> BlockID:
         return BlockID(hash=self.hash(), parts_header=self.make_part_set(part_size).header)

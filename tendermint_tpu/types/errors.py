@@ -48,6 +48,13 @@ class ErrVoteConflictingVotes(VoteError):
         self.vote_b = vote_b
 
 
+class ErrEvidenceUnprovable(ValidationError):
+    """Evidence naming a validator outside every retained validator set:
+    cannot be verified HERE (valset rotation / max-age horizon), which
+    is not the same as forged — relaying peers are not penalized for it
+    (`evidence/reactor.py`)."""
+
+
 class ErrValidatorsChanged(ValidationError):
     """A commit's validators hash differs from the certifier's trusted
     set (reference `certifiers/errors.go` IsValidatorsChangedErr)."""

@@ -69,6 +69,9 @@ class VoteSet:
         self.maj23: BlockID | None = None
         self.votes_by_block: dict[bytes, _BlockVotes] = {}
         self.peer_maj23s: dict[str, BlockID] = {}
+        # insertion-ordered keys of claim-created (still-empty) tallies,
+        # for bounded eviction — see MAX_PEER_CLAIMS
+        self._claim_keys: list[bytes] = []
 
     # -- add ----------------------------------------------------------------
 
@@ -182,9 +185,19 @@ class VoteSet:
 
     # -- peer claims --------------------------------------------------------
 
+    # Bound on claim-created tallies a flooding peer set can force into
+    # votes_by_block: each fresh fake block-id claim allocates a
+    # validator-sized _BlockVotes, so without a cap N peers x unlimited
+    # claims is unbounded per-round memory. Oldest still-EMPTY claim
+    # tallies are evicted past the cap; tallies holding real votes are
+    # never dropped (losing votes would be a safety regression).
+    MAX_PEER_CLAIMS = 8
+
     def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
         """A peer claims 2/3 majority for block_id; start tracking its votes
-        even across conflicts (reference `SetPeerMaj23`)."""
+        even across conflicts (reference `SetPeerMaj23`). One claim per
+        peer per vote set; claim-created tallies are bounded (a flooding
+        peer cannot grow per-round state without limit)."""
         with self._lock:
             if peer_id in self.peer_maj23s:
                 return
@@ -193,10 +206,16 @@ class VoteSet:
             bv = self.votes_by_block.get(key)
             if bv is not None:
                 bv.peer_maj23 = True
-            else:
-                self.votes_by_block[key] = _BlockVotes(
-                    peer_maj23=True, num_validators=self.val_set.size()
-                )
+                return
+            self.votes_by_block[key] = _BlockVotes(
+                peer_maj23=True, num_validators=self.val_set.size()
+            )
+            self._claim_keys.append(key)
+            while len(self._claim_keys) > self.MAX_PEER_CLAIMS:
+                old = self._claim_keys.pop(0)
+                stale = self.votes_by_block.get(old)
+                if stale is not None and stale.sum == 0 and old != key:
+                    del self.votes_by_block[old]
 
     # -- queries ------------------------------------------------------------
 

@@ -173,7 +173,7 @@ class ChainSim:
         by_addr = {p.address: p for p in self.privs}
         return [by_addr[v.address] for v in self.state.validators.validators]
 
-    def make_next_block(self, txs=None):
+    def make_next_block(self, txs=None, evidence=None):
         from tendermint_tpu.types import Commit, Txs
         from tendermint_tpu.types.block import Block
 
@@ -189,6 +189,7 @@ class ChainSim:
             validators_hash=self.state.validators.hash(),
             app_hash=self.state.app_hash,
             hasher=self.hasher,
+            evidence=evidence,
         )
         return block, block.make_part_set(hasher=self.hasher)
 

@@ -1,0 +1,371 @@
+"""Byzantine adversaries against live consensus networks (ISSUE 9
+acceptance): the equivocation -> evidence -> commit pipeline, proposer
+equivocation safety, garbage-signature floods vs. the breaker, and the
+lying fast-sync peer.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tendermint_tpu.services.resilient import ResilientVerifier
+from tendermint_tpu.services.verifier import HostBatchVerifier
+from tendermint_tpu.telemetry import REGISTRY
+from tendermint_tpu.telemetry.flightrec import FLIGHT
+from tendermint_tpu.testing import (
+    ConflictingProposer,
+    Equivocator,
+    GarbageSigFlooder,
+    LyingFastSyncPeer,
+    Nemesis,
+)
+from tendermint_tpu.testing.byzantine import committed_evidence, wait_evidence_committed
+from tendermint_tpu.utils.circuit import CircuitBreaker
+
+
+def _resilient_factory(threshold=2, reset_s=0.5):
+    def factory(_i):
+        return ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(
+                failure_threshold=threshold, reset_timeout_s=reset_s
+            ),
+            max_retries=0,
+        )
+
+    return factory
+
+
+class TestEquivocation:
+    def test_equivocator_evidence_committed_within_five_heights(self, tmp_path):
+        """THE acceptance scenario: a 4-validator net with one
+        equivocating validator — honest nodes detect the conflicting
+        votes, pool DuplicateVoteEvidence (verified through the batched
+        verify seam), gossip it on channel 0x38, and commit it in a
+        block within <= 5 heights of the offense; no fork, continuous
+        progress, and the flight recorder holds the detection event."""
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            eq = Equivocator(net, 3).start()
+            try:
+                honest = [0, 1, 2]
+                found = wait_evidence_committed(
+                    net, eq.address, nodes=honest, within_heights=5, timeout=60
+                )
+                assert eq.equivocations > 0
+                # every honest node committed the SAME offender's proof
+                for node_idx, height in found.items():
+                    evs = [
+                        e
+                        for h, e in committed_evidence(net, node_idx)
+                        if h == height
+                    ]
+                    assert any(e.address == eq.address for e in evs)
+                # liveness continues past the punishment
+                net.wait_progress(delta=2, timeout=60)
+                net.check_invariants()  # no fork
+                # the black box recorded both ends of the pipeline
+                assert FLIGHT.recent(kind="evidence_detected")
+                assert FLIGHT.recent(kind="evidence_added")
+            finally:
+                eq.stop()
+
+    def test_equivocation_survives_offender_crash(self, tmp_path):
+        """Evidence already pooled must survive the network losing the
+        offender: pools are WAL-backed and gossip re-offers pending
+        proofs, so commitment happens even after the byzantine node
+        goes dark."""
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            eq = Equivocator(net, 3).start()
+            try:
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and not any(
+                    net.nodes[i].evidence_pool.depth()
+                    or committed_evidence(net, i)
+                    for i in (0, 1, 2)
+                ):
+                    time.sleep(0.05)
+            finally:
+                eq.stop()
+            net.crash(3)  # the offender vanishes; 3 honest nodes remain
+            wait_evidence_committed(
+                net, eq.address, nodes=[0, 1, 2], timeout=60
+            )
+            net.wait_progress(delta=1, nodes=[0, 1, 2], timeout=60)
+            net.check_invariants()
+
+
+class TestConflictTipsQuorum:
+    def test_conflicting_vote_that_tips_quorum_still_commits(self):
+        """Deterministic regression for the liveness wedge the full-net
+        equivocation runs exposed: a conflicting vote for a
+        peer-maj23-tracked block is TALLIED first and raises second
+        (`VoteSet._add_verified_vote`), so the +2/3 it just tipped must
+        still drive the commit transitions — the evidence handler cannot
+        simply swallow the exception, or the height wedges forever (no
+        later vote re-triggers; duplicates don't re-add)."""
+        import time as _time
+
+        from tendermint_tpu.types.block_id import BlockID
+        from tendermint_tpu.types.part_set import PartSetHeader
+        from tendermint_tpu.types.vote import (
+            VOTE_TYPE_PRECOMMIT,
+            VOTE_TYPE_PREVOTE,
+            Vote,
+        )
+        from tests.test_consensus import CHAIN, Fixture
+
+        fx = Fixture(n_vals=4)
+        fx.cs.start()
+        try:
+            bid = fx.proposal_block_id()
+            # polka: everyone prevotes the block; our node precommits it
+            fx.inject_votes(VOTE_TYPE_PREVOTE, bid, [1, 2, 3])
+            fx.wait_step("Precommit")
+            pc = fx.cs.votes.precommits(0)
+
+            # validator 3 equivocates: its FAKE precommit lands first,
+            # occupying its slot in the canonical vote list
+            fake_bid = BlockID(b"\xbe\xef" * 16, PartSetHeader.zero())
+            fake = Vote(
+                validator_address=fx.privs[3].address,
+                validator_index=3,
+                height=fx.cs.height,
+                round=0,
+                timestamp=_time.time_ns(),
+                type=VOTE_TYPE_PRECOMMIT,
+                block_id=fake_bid,
+            )
+            fake = fake.with_signature(
+                fx.privs[3]._signer.sign(fake.sign_bytes(CHAIN))
+            )
+            fx.cs.add_vote(fake, peer_id="peer3")
+            deadline = _time.time() + 10
+            while _time.time() < deadline and pc.get_by_index(3) is None:
+                _time.sleep(0.01)
+            assert pc.get_by_index(3) is not None
+
+            # a peer claims +2/3 for the REAL block: conflicts against it
+            # now tally before raising (reference SetPeerMaj23 semantics)
+            pc.set_peer_maj23("claimer", bid)
+            # ours + validator 1 = 20/40: below quorum...
+            fx.inject_votes(VOTE_TYPE_PRECOMMIT, bid, [1])
+            # ...validator 3's REAL precommit tips it to 30/40 >= +2/3
+            # AND raises ErrVoteConflictingVotes in the same call
+            fx.inject_votes(VOTE_TYPE_PRECOMMIT, bid, [3])
+            # pre-fix: permanent wedge here (MockTicker fires no
+            # round-skip rescue); post-fix: the height commits
+            fx.wait_height(1, timeout=15)
+            # the equivocation was still recorded on its way through
+            assert FLIGHT.recent(kind="evidence_detected")
+        finally:
+            fx.cs.stop()
+
+
+class TestConflictingProposer:
+    def test_split_proposal_keeps_safety_and_liveness(self, tmp_path):
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            cp = ConflictingProposer(net, 1).start()
+            try:
+                deadline = time.monotonic() + 45
+                while time.monotonic() < deadline and cp.conflicts < 2:
+                    time.sleep(0.05)
+                assert cp.conflicts >= 1, "proposer never got a turn"
+                net.wait_progress(delta=3, timeout=60)
+                net.check_invariants()
+            finally:
+                cp.stop()
+
+
+class TestGarbageSigFlood:
+    def test_flooder_banned_breaker_stays_closed(self, tmp_path):
+        """Satellite + acceptance: a sustained forged-sig flood through
+        the vote drain AND mempool ingress debits the peer into a ban
+        while `tendermint_breaker_state{kind=verify}` stays 0 — False
+        verdicts are ADVERSARIAL INPUT, never device failures, so one
+        attacker cannot DoS the TPU fast path into host crypto."""
+        trips_before = REGISTRY.counter_value(
+            "tendermint_breaker_transitions_total", kind="verify", to="open"
+        )
+        with Nemesis(
+            4, home=str(tmp_path), verifier_factory=_resilient_factory()
+        ) as net:
+            net.wait_height(2, timeout=60)
+            flooder = GarbageSigFlooder(net.nodes[0], net.chain_id)
+            try:
+                # sustained: keep refilling the channel queue until banned
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline and not flooder.banned():
+                    flooder.flood_votes(64)
+                    flooder.flood_txs(64)
+                    time.sleep(0.05)
+                assert flooder.banned(), "flooder never banned"
+                assert not flooder.reconnect(), "banned peer re-admitted"
+                # bad_sig offenses were scored...
+                assert (
+                    REGISTRY.counter_value(
+                        "tendermint_p2p_peer_misbehavior_total", kind="bad_sig"
+                    )
+                    > 0
+                )
+                assert (
+                    REGISTRY.counter_value("tendermint_p2p_peer_bans_total") > 0
+                )
+                # ...and the breaker NEVER conflated them with device
+                # faults: no trips, every node still closed (= 0)
+                assert (
+                    REGISTRY.counter_value(
+                        "tendermint_breaker_transitions_total",
+                        kind="verify",
+                        to="open",
+                    )
+                    == trips_before
+                )
+                assert all(
+                    n.cs.verifier.breaker.state == "closed" for n in net.nodes
+                )
+                assert (
+                    REGISTRY.counter_value("tendermint_breaker_state", kind="verify")
+                    == 0
+                )
+                # honest consensus traffic was never starved
+                net.wait_progress(delta=2, timeout=60)
+                net.check_invariants()
+            finally:
+                flooder.stop()
+
+
+class TestIngressFloodRecovery:
+    def test_admission_throughput_recovers_after_flood(self):
+        """Acceptance: honest-tx admission throughput after a forged-sig
+        flood recovers to within 2x of the pre-flood rate, and the
+        verify breaker never opens (the flood degrades nothing)."""
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.abci.client import local_client_creator
+        from tendermint_tpu.crypto.keys import gen_priv_key
+        from tendermint_tpu.mempool.ingress import SIGNED_TX_MAGIC, make_signed_tx
+        from tendermint_tpu.mempool.mempool import Mempool
+
+        verifier = ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.5),
+            max_retries=0,
+        )
+        conns = local_client_creator(KVStoreApp())()
+        pool = Mempool(conns.mempool, verifier=verifier, ingress_batch=True)
+        priv = gen_priv_key(b"\x11" * 32)
+        try:
+            def admit_rate(n, tag):
+                t0 = time.perf_counter()
+                last = None
+                for i in range(n):
+                    last = pool.check_tx_async(
+                        make_signed_tx(priv, b"%s-%d=%d" % (tag, i, i))
+                    )
+                last.wait(30)
+                return n / (time.perf_counter() - t0)
+
+            before = admit_rate(300, b"pre")
+            # the flood: forged envelopes, every signature invalid
+            bad_sig = REGISTRY.counter_value(
+                "tendermint_mempool_txs_total", result="bad_sig"
+            )
+            last = None
+            for i in range(2000):
+                forged = (
+                    SIGNED_TX_MAGIC
+                    + bytes(32)
+                    + bytes(64)
+                    + b"flood-%d" % i
+                )
+                last = pool.check_tx_async(forged)
+            res = last.wait(30)
+            assert not res.is_ok
+            assert (
+                REGISTRY.counter_value(
+                    "tendermint_mempool_txs_total", result="bad_sig"
+                )
+                - bad_sig
+                >= 2000
+            )
+            # adversarial False verdicts are not device failures
+            assert verifier.breaker.state == "closed"
+            after = admit_rate(300, b"post")
+            assert after >= before / 2, (
+                f"admission throughput did not recover: "
+                f"{before:.0f} -> {after:.0f} tx/s"
+            )
+        finally:
+            pool.close()
+
+
+class TestLyingFastSyncPeer:
+    def test_forged_chain_rejected_and_liar_banned(self):
+        """A fast-syncing node offered a forged chain must apply NONE of
+        it, ban the liar (forged_block debit), and finish syncing from
+        the honest peer."""
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.abci.client import local_client_creator
+        from tendermint_tpu.blockchain import BlockchainReactor, BlockStore
+        from tendermint_tpu.db.kv import MemDB
+        from tendermint_tpu.p2p import NodeInfo, Switch, connect_switches
+        from tendermint_tpu.state import make_genesis_state
+
+        from tests.helpers import CHAIN_ID, ChainSim
+
+        sim = ChainSim(n_vals=4)
+        store = BlockStore(MemDB())
+        for _ in range(40):
+            block = sim.advance()
+            store.save_block(block, block.make_part_set(), sim.commits[-1])
+
+        server = Switch(NodeInfo(node_id="server", moniker="s", chain_id=CHAIN_ID))
+        server.add_reactor(
+            "blockchain",
+            BlockchainReactor(
+                state=sim.state,
+                store=store,
+                app_conn=sim.conns.consensus,
+                fast_sync=False,
+            ),
+        )
+        server.start()
+
+        fresh_state = make_genesis_state(MemDB(), sim.genesis)
+        fresh_state.save()
+        fresh_store = BlockStore(MemDB())
+        conns = local_client_creator(KVStoreApp())()
+        client_reactor = BlockchainReactor(
+            state=fresh_state,
+            store=fresh_store,
+            app_conn=conns.consensus,
+            fast_sync=True,
+        )
+        client = Switch(NodeInfo(node_id="fresh", moniker="f", chain_id=CHAIN_ID))
+        client.add_reactor("blockchain", client_reactor)
+        client.start()
+        liar = LyingFastSyncPeer(client, CHAIN_ID, claim_height=500)
+        try:
+            connect_switches(server, client)
+            deadline = time.time() + 90
+            while time.time() < deadline and fresh_store.height < 39:
+                time.sleep(0.05)
+            assert fresh_store.height >= 39, "victim never synced honest chain"
+            # forged blocks never entered the store
+            for h in (1, 20, 39):
+                assert (
+                    fresh_store.load_block(h).hash() == store.load_block(h).hash()
+                )
+            assert liar.blocks_served > 0, "liar was never even asked"
+            deadline = time.time() + 30
+            while time.time() < deadline and not liar.banned():
+                time.sleep(0.05)
+            assert liar.banned(), "lying peer was not banned"
+        finally:
+            liar.stop()
+            server.stop()
+            client.stop()

@@ -119,6 +119,137 @@ def run_ingress_scenario(args) -> int:
     return 0
 
 
+def run_byzantine_scenario(args) -> int:
+    """The adversary book: each scenario runs a live network, unleashes
+    one Byzantine driver from `testing/byzantine.py`, and records a
+    verdict — evidence committed, attacker banned, breaker closed, no
+    fork, liveness held (docs/BYZANTINE.md)."""
+    import time as _time
+
+    from tendermint_tpu.services.resilient import ResilientVerifier
+    from tendermint_tpu.services.verifier import HostBatchVerifier
+    from tendermint_tpu.telemetry import REGISTRY
+    from tendermint_tpu.testing import (
+        ConflictingProposer,
+        Equivocator,
+        FrameFuzzer,
+        GarbageSigFlooder,
+        Nemesis,
+    )
+    from tendermint_tpu.testing.byzantine import wait_evidence_committed
+    from tendermint_tpu.utils.circuit import CircuitBreaker
+
+    def verifier_factory(_i):
+        return ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(failure_threshold=2, reset_timeout_s=0.5),
+            max_retries=0,
+        )
+
+    verdicts: list[tuple[str, str, str]] = []  # (scenario, verdict, detail)
+    t_all = _time.time()
+    with Nemesis(
+        args.nodes,
+        home=tempfile.mkdtemp(prefix="nemesis-byz-"),
+        verifier_factory=verifier_factory,
+    ) as net:
+        print(f"[1/4] equivocating validator (node {args.nodes - 1}) ...")
+        net.wait_height(2, timeout=args.timeout)
+        eq = Equivocator(net, args.nodes - 1).start()
+        try:
+            honest = list(range(args.nodes - 1))
+            found = wait_evidence_committed(
+                net, eq.address, nodes=honest, within_heights=5,
+                timeout=args.timeout,
+            )
+            verdicts.append(
+                (
+                    "equivocator",
+                    "PASS",
+                    f"{eq.equivocations} double-signs -> evidence committed "
+                    f"at heights {sorted(set(found.values()))} on all "
+                    f"{len(honest)} honest nodes (<= 5 heights late)",
+                )
+            )
+        finally:
+            eq.stop()
+
+        print("[2/4] conflicting proposer (node 1) ...")
+        cp = ConflictingProposer(net, 1).start()
+        try:
+            deadline = _time.time() + args.timeout
+            while _time.time() < deadline and cp.conflicts < 2:
+                _time.sleep(0.05)
+            net.wait_progress(delta=3, timeout=args.timeout)
+            net.check_invariants()
+            verdicts.append(
+                (
+                    "conflicting-proposer",
+                    "PASS",
+                    f"{cp.conflicts} split proposals; no fork, progress held",
+                )
+            )
+        finally:
+            cp.stop()
+
+        print("[3/4] garbage-signature flooder vs node 0 ...")
+        trips_before = REGISTRY.counter_value(
+            "tendermint_breaker_transitions_total", kind="verify", to="open"
+        )
+        flooder = GarbageSigFlooder(net.nodes[0], net.chain_id)
+        try:
+            deadline = _time.time() + args.timeout
+            while _time.time() < deadline and not flooder.banned():
+                flooder.flood_votes(64)
+                flooder.flood_txs(64)
+                _time.sleep(0.05)
+            trips = (
+                REGISTRY.counter_value(
+                    "tendermint_breaker_transitions_total",
+                    kind="verify",
+                    to="open",
+                )
+                - trips_before
+            )
+            banned = flooder.banned() and not flooder.reconnect()
+            breakers = [n.cs.verifier.breaker.state for n in net.nodes]
+            ok = banned and trips == 0 and all(s == "closed" for s in breakers)
+            verdicts.append(
+                (
+                    "sig-flooder",
+                    "PASS" if ok else "FAIL",
+                    f"banned={banned}, breaker trips={trips:.0f}, "
+                    f"states={breakers}",
+                )
+            )
+            net.wait_progress(delta=2, timeout=args.timeout)
+        finally:
+            flooder.stop()
+
+        print("[4/4] wire-frame fuzzer vs node 1 ...")
+        fuzzer = FrameFuzzer(net.nodes[1].switch, net.chain_id)
+        sent = fuzzer.run(args.fuzz_frames)
+        fuzzer.stop()
+        net.wait_progress(delta=1, timeout=args.timeout)
+        net.check_invariants()
+        verdicts.append(
+            (
+                "frame-fuzzer",
+                "PASS",
+                f"{sent} mutated frames across {fuzzer.reconnects} "
+                f"identities; node alive, no fork",
+            )
+        )
+
+    print(f"\nadversary book done in {_time.time() - t_all:.1f}s:")
+    width = max(len(s) for s, _, _ in verdicts)
+    failed = 0
+    for scenario, verdict, detail in verdicts:
+        print(f"  {scenario:<{width}}  {verdict}  {detail}")
+        failed += verdict != "PASS"
+    return 1 if failed else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
@@ -130,8 +261,18 @@ def main() -> int:
         help="run the ingress-under-chaos scenario (full nodes + loadgen "
         "traffic through partition heal + breaker trip) instead",
     )
+    ap.add_argument(
+        "--byzantine",
+        action="store_true",
+        help="run the Byzantine adversary book (equivocator -> evidence "
+        "committed; flooder -> banned, breaker closed; proposer "
+        "equivocation; frame fuzzing) instead",
+    )
     ap.add_argument("--rate", type=float, default=150.0, help="ingress tx/s")
     ap.add_argument("--txs", type=int, default=1000, help="ingress tx cap")
+    ap.add_argument(
+        "--fuzz-frames", type=int, default=5000, help="byzantine fuzz frame count"
+    )
     args = ap.parse_args()
 
     if args.ingress:
@@ -139,6 +280,12 @@ def main() -> int:
 
         setup_logging("resilient:info,nemesis:info,*:error")
         return run_ingress_scenario(args)
+
+    if args.byzantine:
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging("byzantine:info,evidence:warning,nemesis:info,*:error")
+        return run_byzantine_scenario(args)
 
     from tendermint_tpu.services.resilient import ResilientVerifier
     from tendermint_tpu.services.verifier import HostBatchVerifier
