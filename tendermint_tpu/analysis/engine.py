@@ -1,0 +1,290 @@
+"""The tmlint engine: file walking, rule dispatch, suppressions, baseline.
+
+Design goals (ISSUE 10): one lint engine and one baseline format for
+every repo invariant; AST-based file rules plus repo-scope catalog
+rules; suppressions must carry a reason; full-package runs stay well
+under 5 s so the engine can gate tier-1 collection.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+
+
+def repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+DEFAULT_BASELINE = "tools/tmlint_baseline.json"
+
+# `# tmlint: disable=L001` or `# tmlint: disable=L001,L002 -- reason`
+_SUPPRESS_RE = re.compile(
+    r"#\s*tmlint:\s*disable=([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)"
+    r"(?:\s*--\s*(.*?))?\s*$"
+)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative (or absolute for out-of-repo roots)
+    line: int
+    message: str
+    source: str = ""  # stripped source line, for fingerprinting
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.source}".encode()
+        ).hexdigest()
+        return h[:16]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)  # fresh (fail)
+    baselined: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+class SourceFile:
+    """One parsed source handed to file rules (AST parsed once)."""
+
+    def __init__(self, path: pathlib.Path, rel: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree: ast.AST | None = None
+        self.parse_error: str | None = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as e:
+            self.parse_error = str(e)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: str, lineno: int, message: str) -> Finding:
+        return Finding(rule, self.rel, lineno, message, self.line_at(lineno))
+
+
+# -- rule registry ------------------------------------------------------------
+
+
+def all_rules() -> dict[str, "object"]:
+    """code -> rule instance. Imported lazily so `tools/tmlint.py
+    --list-rules` stays cheap and rule modules can import the engine."""
+    from tendermint_tpu.analysis import (
+        rules_catalog,
+        rules_jax,
+        rules_locks,
+        rules_threads,
+        rules_wire,
+    )
+
+    rules = [
+        rules_locks.LockOrderRule(),
+        rules_locks.BlockingUnderLockRule(),
+        rules_threads.SilentThreadDeathRule(),
+        rules_wire.TrailingOptionalRule(),
+        rules_jax.JaxPurityRule(),
+        rules_catalog.MetricCatalogRule(),
+        rules_catalog.SpanCatalogRule(),
+        rules_catalog.KernelMarkRule(),
+    ]
+    return {r.code: r for r in rules}
+
+
+# -- suppressions -------------------------------------------------------------
+
+
+def _suppressions(src: SourceFile) -> tuple[dict[int, set[str]], list[Finding]]:
+    """line -> suppressed rule codes; plus S001 findings for reasonless
+    suppressions (a suppression must say WHY — reasonless ones fail)."""
+    table: dict[int, set[str]] = {}
+    bad: list[Finding] = []
+    for i, line in enumerate(src.lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        codes = {c.strip() for c in m.group(1).split(",")}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(
+                src.finding(
+                    "S001",
+                    i,
+                    "suppression without a reason — write "
+                    "`# tmlint: disable=RULE -- why this is safe`",
+                )
+            )
+            continue
+        table[i] = codes
+    return table, bad
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: pathlib.Path | str | None) -> dict:
+    if path is None:
+        return {}
+    p = pathlib.Path(path)
+    if not p.exists():
+        return {}
+    data = json.loads(p.read_text(encoding="utf-8"))
+    return data.get("findings", {})
+
+
+def write_baseline(path: pathlib.Path | str, findings: list[Finding]) -> None:
+    entries = {}
+    for f in findings:
+        entries[f.fingerprint()] = {
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "source": f.source,
+        }
+    payload = {
+        "version": 1,
+        "comment": (
+            "tmlint findings baseline: grandfathered sites. Entries are "
+            "keyed by sha1(rule|path|source-line) so line drift does not "
+            "invalidate them. Regenerate with tools/tmlint.py "
+            "--write-baseline; prefer fixing or reason-annotated "
+            "suppressions over baselining."
+        ),
+        "findings": entries,
+    }
+    pathlib.Path(path).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+
+
+# -- driver -------------------------------------------------------------------
+
+
+def _is_fixture(path: pathlib.Path) -> bool:
+    """The rule fixture corpus (analysis/fixtures/) contains deliberate
+    violations; directory walks skip it — lint it by naming a fixture
+    file explicitly (which is what tests/test_tmlint.py does)."""
+    parts = path.parts
+    for i in range(len(parts) - 1):
+        if parts[i] == "analysis" and parts[i + 1] == "fixtures":
+            return True
+    return False
+
+
+def iter_py_files(paths: list[pathlib.Path]) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for p in paths:
+        if p.is_file() and p.suffix == ".py":
+            out.append(p)
+        elif p.is_dir():
+            out.extend(
+                f
+                for f in sorted(p.rglob("*.py"))
+                if "__pycache__" not in f.parts and not _is_fixture(f)
+            )
+    return out
+
+
+def lint_paths(
+    paths: list[pathlib.Path | str],
+    rules: list[str] | None = None,
+    baseline_path: pathlib.Path | str | None = None,
+    root: pathlib.Path | None = None,
+) -> Report:
+    """Run `rules` (default: all) over `paths`; returns the Report with
+    fresh findings (suppressions applied, baseline subtracted)."""
+    root = root or repo_root()
+    registry = all_rules()
+    if rules is not None:
+        unknown = set(rules) - set(registry) - {"S001"}
+        if unknown:
+            raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        registry = {c: r for c, r in registry.items() if c in rules}
+    files = iter_py_files([pathlib.Path(p) for p in paths])
+    report = Report(files_checked=len(files))
+    raw: list[Finding] = []
+    sources: list[SourceFile] = []
+    suppress_tables: dict[str, dict[int, set[str]]] = {}
+    for path in files:
+        try:
+            rel = str(path.resolve().relative_to(root))
+        except ValueError:
+            rel = str(path)
+        src = SourceFile(path, rel)
+        sources.append(src)
+        table, bad = _suppressions(src)
+        suppress_tables[rel] = table
+        if rules is None or "S001" in rules:
+            raw.extend(bad)
+        if src.parse_error is not None:
+            raw.append(
+                src.finding("E999", 1, f"syntax error: {src.parse_error}")
+            )
+            continue
+        for rule in registry.values():
+            if getattr(rule, "repo_scope", False):
+                continue
+            if not rule.applies_to(src):
+                continue
+            raw.extend(rule.check(src))
+    # repo-scope rules see the whole file set at once
+    for rule in registry.values():
+        if getattr(rule, "repo_scope", False):
+            raw.extend(rule.check_repo(sources))
+
+    baseline = load_baseline(baseline_path)
+    seen_fps: set[str] = set()
+    for f in raw:
+        table = suppress_tables.get(f.path, {})
+        codes = table.get(f.line, set()) | table.get(f.line - 1, set())
+        if f.rule in codes:
+            report.suppressed.append(f)
+            continue
+        fp = f.fingerprint()
+        seen_fps.add(fp)
+        if fp in baseline:
+            report.baselined.append(f)
+        else:
+            report.findings.append(f)
+    report.stale_baseline = sorted(set(baseline) - seen_fps)
+    report.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return report
+
+
+def render_report(report: Report, verbose: bool = False) -> str:
+    lines = [f.render() for f in report.findings]
+    if verbose:
+        for f in report.baselined:
+            lines.append(f.render() + "  [baselined]")
+        for f in report.suppressed:
+            lines.append(f.render() + "  [suppressed]")
+    summary = (
+        f"tmlint: {len(report.findings)} finding(s), "
+        f"{len(report.baselined)} baselined, "
+        f"{len(report.suppressed)} suppressed, "
+        f"{report.files_checked} file(s)"
+    )
+    if report.stale_baseline:
+        summary += f", {len(report.stale_baseline)} stale baseline entr(y/ies)"
+    lines.append(summary)
+    return "\n".join(lines)

@@ -1,0 +1,31 @@
+"""tmlint fixture: J001 JAX purity violations (deliberately bad).
+
+Never imported — parsed only; the jax names are placeholders.
+"""
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy(x):
+    print("tracing")  # runs once per compile, not per call
+    t = time.time()  # host clock frozen into the trace
+    return x + t
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def branch_on_traced(x, mode):
+    if x > 0:  # BAD: x is traced
+        return x
+    return -x
+
+
+@jax.jit
+def while_on_traced(n):
+    while n > 0:  # BAD: traced loop condition
+        n = n - 1
+    return n

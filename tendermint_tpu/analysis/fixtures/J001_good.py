@@ -1,0 +1,27 @@
+"""tmlint fixture: J001-clean jitted functions."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def branch_on_static(x, mode):
+    if mode == "neg":  # mode is static: concrete at trace time
+        return -x
+    return x
+
+
+@jax.jit
+def branch_on_shape(x):
+    if x.shape[0] == 0:  # shapes are concrete at trace time
+        return x
+    if len(x) > 4:  # len() of an array is its (static) leading dim
+        return x[:4]
+    return jnp.where(x > 0, x, -x)  # traced select belongs on-device
+
+
+def host_helper(x):
+    print("not jitted: host effects are fine here")
+    return x

@@ -1,0 +1,21 @@
+"""tmlint fixture: L001 lock-order violations (deliberately bad)."""
+
+from tendermint_tpu.utils.lockrank import ranked_lock
+
+
+class Pool:
+    def __init__(self):
+        self._wal_lock = ranked_lock("mempool.wal")
+        self._counter_lock = ranked_lock("mempool.counter")
+        self._avail_lock = ranked_lock("mempool.avail")
+
+    def inverted(self):
+        # counter (52) then wal (48): descends the rank table
+        with self._counter_lock:
+            with self._wal_lock:
+                return 1
+
+    def inverted_multi_item(self):
+        # one `with`, two items, still out of order
+        with self._wal_lock, self._avail_lock:
+            return 2

@@ -1,0 +1,29 @@
+"""tmlint fixture: L001-clean nesting (ascending rank order)."""
+
+from tendermint_tpu.utils.lockrank import ranked_lock
+
+
+class Pool:
+    def __init__(self):
+        self._wal_lock = ranked_lock("mempool.wal")
+        self._counter_lock = ranked_lock("mempool.counter")
+
+    def ordered(self):
+        with self._wal_lock:
+            with self._counter_lock:
+                return 1
+
+    def sequential_not_nested(self):
+        with self._counter_lock:
+            hi = 1
+        with self._wal_lock:
+            return hi
+
+    def nested_def_resets_held(self):
+        with self._counter_lock:
+            def helper():
+                # not executed under the lock at this site
+                with self._wal_lock:
+                    return 2
+
+            return helper

@@ -1,0 +1,27 @@
+"""tmlint fixture: L002 blocking calls under a lock (deliberately bad)."""
+
+import time
+
+from tendermint_tpu.utils.lockrank import ranked_lock
+
+
+class Worker:
+    def __init__(self, handle, thread, q):
+        self._lock = ranked_lock("dispatch.state")
+        self.handle = handle
+        self.thread = thread
+        self.q = q
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)
+
+    def join_result_under_lock(self):
+        with self._lock:
+            v = self.handle.result()
+            self.thread.join()
+            return v, self.q.get()
+
+    def foreign_wait_under_lock(self, event):
+        with self._lock:
+            event.wait()

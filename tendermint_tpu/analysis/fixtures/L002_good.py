@@ -1,0 +1,31 @@
+"""tmlint fixture: L002-clean patterns."""
+
+import threading
+import time
+
+from tendermint_tpu.utils.lockrank import ranked_lock
+
+
+class Worker:
+    def __init__(self, handle, thread, q):
+        self._lock = ranked_lock("dispatch.state")
+        self._cond = threading.Condition()
+        self.handle = handle
+        self.thread = thread
+        self.q = q
+
+    def blocking_outside_lock(self):
+        v = self.handle.result()
+        self.thread.join()
+        time.sleep(0.01)
+        with self._lock:
+            return v, self.q.get_nowait()
+
+    def condition_self_wait(self):
+        # the one blocking call a lock body is FOR
+        with self._cond:
+            self._cond.wait(0.1)
+
+    def non_blocking_lookalikes(self, d, parts):
+        with self._lock:
+            return d.get("key"), ",".join(parts)

@@ -1,0 +1,5 @@
+"""tmlint fixture: M001 — metric literal missing from the catalog."""
+
+NAME = "tendermint_not_in_the_catalog_total"
+OK_SUFFIX = "tendermint_verify_seconds_count"  # exposition suffix: fine
+PKG = "tendermint_tpu.services"  # package path, not a metric

@@ -1,0 +1,8 @@
+"""tmlint fixture: M002 — span literal missing from SPAN_CATALOG."""
+
+TRACER = None
+
+
+def record():
+    TRACER.add("not.in.catalog", 0.0, 1.0)
+    TRACER.add("mempool.admission", 0.0, 1.0)  # cataloged: fine

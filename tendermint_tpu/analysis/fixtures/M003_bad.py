@@ -1,0 +1,24 @@
+"""tmlint fixture: M003 — kernel mark without slow (file is named like a
+fixture, so tests feed it to the engine under a test_*.py alias)."""
+
+import pytest
+
+
+@pytest.mark.kernel
+def test_compiles_kernel_only():
+    pass
+
+
+@pytest.mark.kernel
+@pytest.mark.slow
+def test_compiles_both_marks():
+    pass
+
+
+@pytest.mark.kernel
+class TestKernelClass:
+    def test_inherits_kernel_only(self):
+        pass
+
+
+pytestmark = []

@@ -1,0 +1,17 @@
+"""tmlint fixture: S001 — suppression without a reason string."""
+
+import time
+
+from tendermint_tpu.utils.lockrank import ranked_lock
+
+_lock = ranked_lock("dispatch.state")
+
+
+def suppressed_without_reason():
+    with _lock:
+        time.sleep(0.1)  # tmlint: disable=L002
+
+
+def suppressed_with_reason():
+    with _lock:
+        time.sleep(0.1)  # tmlint: disable=L002 -- fixture: demonstrates a valid reasoned suppression

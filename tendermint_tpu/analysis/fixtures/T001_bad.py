@@ -1,0 +1,45 @@
+"""tmlint fixture: T001 silent thread death (deliberately bad)."""
+
+
+def anywhere():
+    try:
+        risky()
+    except:  # bare except is flagged anywhere
+        pass
+
+
+def risky():
+    raise RuntimeError
+
+
+class NoisyReactor:
+    def receive(self, chan_id, peer, payload):
+        try:
+            decode(payload)
+        except Exception:
+            pass  # silent swallow in a reactor receive path
+
+
+class Runner:
+    def run(self):
+        while True:
+            try:
+                step()
+            except Exception:
+                continue  # silent swallow in a thread run body
+
+
+def _recv_loop(sock):
+    while True:
+        try:
+            sock.recv(1)
+        except Exception:
+            pass
+
+
+def decode(payload):
+    return payload
+
+
+def step():
+    pass

@@ -1,0 +1,44 @@
+"""tmlint fixture: T001-clean exception handling."""
+
+import logging
+
+log = logging.getLogger(__name__)
+
+
+class QuietReactor:
+    def receive(self, chan_id, peer, payload):
+        try:
+            decode(payload)
+        except ValueError:
+            pass  # narrow catch is fine even when silent
+        except Exception as e:
+            log.warning("bad payload: %s", e)  # observable: not silent
+
+
+class Runner:
+    def run(self):
+        while True:
+            try:
+                step()
+            except Exception as e:
+                self.on_error(e)  # routed, not swallowed
+                return
+
+    def on_error(self, e):
+        log.error("runner died: %s", e)
+
+
+def helper():
+    # overbroad+silent OUTSIDE thread-loop scopes is not T001's business
+    try:
+        step()
+    except Exception:
+        pass
+
+
+def decode(payload):
+    return payload
+
+
+def step():
+    pass

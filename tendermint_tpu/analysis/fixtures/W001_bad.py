@@ -1,0 +1,20 @@
+"""tmlint fixture: W001 wire back-compat violations (deliberately bad)."""
+
+
+def parse_frame_bad(r):
+    chan_id = r.uvarint()
+    payload = r.bytes()
+    if not r.done():
+        trace = r.bytes()  # optional tail begins
+    flags = r.uvarint()  # BAD: mandatory read appended after the tail
+    return chan_id, payload, flags
+
+
+def decode_record_bad(r):
+    tag = r.uvarint()
+    try:
+        extra = r.bytes()  # optional (guarded by try)
+    except ValueError:
+        extra = b""
+    body = r.bytes()  # BAD: unguarded read after the optional region
+    return tag, extra, body

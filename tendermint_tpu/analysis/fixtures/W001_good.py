@@ -1,0 +1,31 @@
+"""tmlint fixture: W001-clean decoders (trailing-optional discipline)."""
+
+
+def parse_frame_good(r):
+    chan_id = r.uvarint()
+    payload = r.bytes()
+    ctx = None
+    if not r.done():
+        try:
+            ctx = r.bytes()
+        except ValueError:
+            ctx = None
+    return chan_id, payload, ctx
+
+
+def decode_msg_good(r):
+    # a read inside an `if` TEST is validation, not an optional region
+    if r.uvarint() != 1:
+        raise ValueError("unknown message")
+    body = r.bytes()
+    extra = None
+    if not r.done():
+        extra = r.bytes()
+    return body, extra
+
+
+def encode_not_in_scope(w, payload):
+    # Writer calls share method names with Reader; encoders are out of scope
+    w.uvarint(1)
+    w.bytes(payload)
+    return w.build()

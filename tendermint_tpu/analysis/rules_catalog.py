@@ -1,0 +1,235 @@
+"""M001/M002/M003: the former tests/conftest.py collection lints,
+re-homed into the tmlint engine (conftest keeps thin shims calling the
+module-level helpers here, so collection behavior and messages are
+unchanged while the CLI and the engine's baseline/suppression
+machinery apply uniformly).
+
+M001  every `tendermint_*` metric literal in the package (and tools/)
+      must be registered in telemetry/metrics.py's REGISTRY.
+M002  every literal passed to TRACER.span()/TRACER.add() must be in
+      telemetry/metrics.py's SPAN_CATALOG.
+M003  every `kernel`-marked test must also carry `slow` (tier-1's
+      `-m 'not slow'` overrides pytest.ini's `-m 'not kernel'`).
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from tendermint_tpu.analysis.engine import (
+    Finding,
+    SourceFile,
+    _is_fixture,
+    repo_root,
+)
+
+_METRIC_PAT = re.compile(r"""["'](tendermint_[a-z0-9_]+)["']""")
+_SPAN_PAT = re.compile(r"""TRACER\.(?:span|add)\(\s*["']([a-z0-9_.]+)["']""")
+
+
+def _registered_metrics() -> set[str]:
+    import tendermint_tpu.telemetry.metrics  # noqa: F401 — fills the registry
+    from tendermint_tpu.telemetry import REGISTRY
+
+    return {m.name for m in REGISTRY.metrics()}
+
+
+def metric_offenders(roots=None) -> list[str]:
+    """`path:name` for unregistered tendermint_* literals — the exact
+    behavior tests/conftest.py::lint_metric_catalog shipped with."""
+    repo = repo_root()
+    if roots is None:
+        roots = [repo / "tendermint_tpu", repo / "tools"]
+    registered = _registered_metrics()
+    offenders: list[str] = []
+    for root in roots:
+        for path in sorted(pathlib.Path(root).rglob("*.py")):
+            if "__pycache__" in path.parts or _is_fixture(path):
+                continue
+            for line, name in _metric_hits(path.read_text(encoding="utf-8")):
+                if _metric_ok(name, registered):
+                    continue
+                try:
+                    shown = path.relative_to(repo)
+                except ValueError:  # lint tests point at tmp dirs
+                    shown = path
+                offenders.append(f"{shown}:{name}")
+    return offenders
+
+
+def _metric_hits(text: str):
+    for i, line in enumerate(text.splitlines(), start=1):
+        for name in _METRIC_PAT.findall(line):
+            yield i, name
+
+
+def _metric_ok(name: str, registered: set[str]) -> bool:
+    if name.startswith("tendermint_tpu"):
+        return True  # the package name, not a metric
+    base = re.sub(r"_(bucket|sum|count)$", "", name)
+    return name in registered or base in registered
+
+
+def span_offenders(roots=None) -> list[str]:
+    from tendermint_tpu.telemetry.metrics import SPAN_CATALOG
+
+    repo = repo_root()
+    if roots is None:
+        roots = [repo / "tendermint_tpu", repo / "tools"]
+    offenders: list[str] = []
+    for root in roots:
+        for path in sorted(pathlib.Path(root).rglob("*.py")):
+            if "__pycache__" in path.parts or _is_fixture(path):
+                continue
+            for name in _SPAN_PAT.findall(path.read_text(encoding="utf-8")):
+                if name in SPAN_CATALOG:
+                    continue
+                try:
+                    shown = path.relative_to(repo)
+                except ValueError:
+                    shown = path
+                offenders.append(f"{shown}:{name}")
+    return offenders
+
+
+def kernel_mark_offenders(items) -> list[str]:
+    """Collected-item variant (pytest collection hook): node ids of
+    kernel-marked tests missing the slow mark."""
+    return [
+        item.nodeid
+        for item in items
+        if item.get_closest_marker("kernel") is not None
+        and item.get_closest_marker("slow") is None
+    ]
+
+
+# -- engine rules -------------------------------------------------------------
+
+
+class MetricCatalogRule:
+    code = "M001"
+    description = "tendermint_* metric literal missing from the catalog"
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return True
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        if src.rel.startswith("tests/") or "test_" in pathlib.Path(src.rel).name:
+            return []  # catalog scope is the package + tools, not tests
+        registered = _registered_metrics()
+        return [
+            src.finding(
+                self.code,
+                line,
+                f"metric {name!r} is not registered in "
+                "telemetry/metrics.py — a dashboard or invariant "
+                "querying it would match nothing",
+            )
+            for line, name in _metric_hits(src.text)
+            if not _metric_ok(name, registered)
+        ]
+
+
+class SpanCatalogRule:
+    code = "M002"
+    description = "TRACER span literal missing from SPAN_CATALOG"
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return "TRACER" in src.text
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        if src.rel.startswith("tests/") or "test_" in pathlib.Path(src.rel).name:
+            return []
+        from tendermint_tpu.telemetry.metrics import SPAN_CATALOG
+
+        findings = []
+        for i, line in enumerate(src.lines, start=1):
+            for name in _SPAN_PAT.findall(line):
+                if name not in SPAN_CATALOG:
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            i,
+                            f"span {name!r} is not in SPAN_CATALOG "
+                            "(telemetry/metrics.py)",
+                        )
+                    )
+        return findings
+
+
+class KernelMarkRule:
+    """Static twin of the collection-time kernel/slow marker lint: finds
+    `pytest.mark.kernel` (decorator or pytestmark list) without a
+    matching `slow` in the same scope chain."""
+
+    code = "M003"
+    description = "kernel-marked test missing the slow mark"
+
+    def applies_to(self, src: SourceFile) -> bool:
+        name = pathlib.Path(src.rel).name
+        return src.tree is not None and (
+            name.startswith("test_") or name == "conftest.py"
+        )
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        module_marks = self._pytestmark_marks(src.tree)
+        findings: list[Finding] = []
+        self._walk(src, src.tree, module_marks, findings)
+        return findings
+
+    def _pytestmark_marks(self, tree) -> set[str]:
+        marks: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "pytestmark"
+                for t in node.targets
+            ):
+                marks |= self._marks_in(node.value)
+        return marks
+
+    @staticmethod
+    def _marks_in(node) -> set[str]:
+        """Names X from pytest.mark.X references in `node`'s subtree."""
+        marks: set[str] = set()
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Attribute)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "mark"
+            ):
+                marks.add(sub.attr)
+        return marks
+
+    def _decorator_marks(self, node) -> set[str]:
+        marks: set[str] = set()
+        for dec in node.decorator_list:
+            marks |= self._marks_in(dec)
+        return marks
+
+    def _walk(self, src, node, inherited: set[str], findings):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                marks = inherited | self._decorator_marks(child)
+                is_test = child.name.startswith(("test_", "Test"))
+                if (
+                    is_test
+                    and not isinstance(child, ast.ClassDef)
+                    and "kernel" in marks
+                    and "slow" not in marks
+                ):
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            child.lineno,
+                            f"{child.name} is kernel-marked but not "
+                            "slow-marked — tier-1's `-m 'not slow'` would "
+                            "pull its XLA compile into the fast lane",
+                        )
+                    )
+                self._walk(src, child, marks, findings)
+            else:
+                self._walk(src, child, inherited, findings)

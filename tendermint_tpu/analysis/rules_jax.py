@@ -1,0 +1,171 @@
+"""J001: JAX purity — no host side effects or Python control flow on
+traced values inside jitted / shard_map'd functions.
+
+A `print()`, `time.time()`, metric increment, or tracer span inside a
+jitted function runs at TRACE time (once per compilation), not per
+call — a silent correctness/observability bug. Python `if`/`while` on
+a traced argument raises `TracerBoolConversionError` at runtime, but
+only on the branch actually traced; this rule catches both statically.
+
+Scope: functions decorated `@jax.jit` / `@jit` /
+`@partial(jax.jit, ...)` (static_argnames/static_argnums respected —
+branching on a static argument is fine, as is branching on `.shape` /
+`.ndim` / `.dtype` / `len(...)`, which are concrete at trace time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.analysis.engine import Finding, SourceFile
+
+# call roots whose invocation inside a jitted body is a host effect
+_EFFECT_ROOTS = {
+    "time",
+    "os",
+    "TRACER",
+    "REGISTRY",
+    "FLIGHT",
+    "logging",
+    "random",
+    "_metrics",
+    "metrics",
+}
+_EFFECT_NAMES = {"print", "open", "breakpoint", "input"}
+
+
+def _jit_decoration(fn: ast.FunctionDef) -> tuple[bool, set[str], set[int]]:
+    """(is_jitted, static_argnames, static_argnums) from decorators."""
+    for dec in fn.decorator_list:
+        # `@jax.jit`, `@jit`, and `@partial(jax.jit, ...)` — for call
+        # decorators the jit reference sits in the ARGS, so walk the
+        # whole decorator expression
+        names: list[str] = []
+        for node in ast.walk(dec):
+            if isinstance(node, ast.Name):
+                names.append(node.id)
+            elif isinstance(node, ast.Attribute):
+                names.append(node.attr)
+        if "jit" not in names and "shard_map" not in names:
+            continue
+        static_names: set[str] = set()
+        static_nums: set[int] = set()
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, str
+                        ):
+                            static_names.add(sub.value)
+                elif kw.arg == "static_argnums":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) and isinstance(
+                            sub.value, int
+                        ):
+                            static_nums.add(sub.value)
+        return True, static_names, static_nums
+    return False, set(), set()
+
+
+def _traced_params(fn: ast.FunctionDef, static_names, static_nums) -> set[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    return {
+        p
+        for i, p in enumerate(params)
+        if p not in static_names and i not in static_nums
+    }
+
+
+def _branch_names(test: ast.AST) -> set[str]:
+    """Bare Names in a branch test, excluding concrete-at-trace-time
+    accessors: attribute chains (x.shape/x.ndim/x.dtype), len()."""
+    names: set[str] = set()
+
+    def visit(node, skip):
+        if isinstance(node, ast.Attribute):
+            return  # x.shape etc: attribute access is concrete or traced-op
+        if isinstance(node, ast.Call):
+            fname = node.func.id if isinstance(node.func, ast.Name) else None
+            if fname in ("len", "isinstance", "getattr", "hasattr"):
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child, skip)
+            return
+        if isinstance(node, ast.Name):
+            names.add(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, skip)
+
+    visit(test, False)
+    return names
+
+
+class JaxPurityRule:
+    code = "J001"
+    description = (
+        "host side effect or Python branch on a traced value inside a "
+        "jitted function"
+    )
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return src.tree is not None and (
+            "jit" in src.text or "shard_map" in src.text
+        )
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            jitted, static_names, static_nums = _jit_decoration(node)
+            if not jitted:
+                continue
+            traced = _traced_params(node, static_names, static_nums)
+            self._check_body(src, node, traced, findings)
+        return findings
+
+    def _check_body(self, src, fn, traced: set[str], findings):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in _EFFECT_NAMES:
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            node.lineno,
+                            f"host call {f.id}() inside jitted "
+                            f"{fn.name}() runs at trace time, not per call",
+                        )
+                    )
+                elif isinstance(f, ast.Attribute):
+                    root = f.value
+                    while isinstance(root, ast.Attribute):
+                        root = root.value
+                    if (
+                        isinstance(root, ast.Name)
+                        and root.id in _EFFECT_ROOTS
+                    ):
+                        findings.append(
+                            src.finding(
+                                self.code,
+                                node.lineno,
+                                f"host side effect "
+                                f"{root.id}.{f.attr}() inside jitted "
+                                f"{fn.name}()",
+                            )
+                        )
+            elif isinstance(node, (ast.If, ast.While)):
+                hit = _branch_names(node.test) & traced
+                if hit:
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            node.lineno,
+                            f"Python branch on traced value(s) "
+                            f"{', '.join(sorted(hit))} inside jitted "
+                            f"{fn.name}() — use jnp.where/lax.cond or mark "
+                            "the argument static",
+                        )
+                    )

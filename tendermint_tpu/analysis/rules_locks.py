@@ -1,0 +1,257 @@
+"""L001 lock-order and L002 blocking-call-under-lock.
+
+Both rules resolve `with <expr>:` context managers to entries in the
+declared rank table (`utils/lockrank.py RANKS`). Resolution is
+two-stage:
+
+1. module-local: any assignment whose value contains a
+   ``ranked_lock("name", ...)`` / ``ranked_rlock("name", ...)`` call
+   (including wrapped ones like ``threading.Condition(ranked_lock(...))``)
+   binds the assigned attribute to that rank name, so annotating a lock
+   at its construction site is all a module needs;
+2. a fallback suffix table for idioms the assignment scan can't see
+   (``lane.lock`` — the lane object is built in another class).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tendermint_tpu.analysis.engine import Finding, SourceFile
+from tendermint_tpu.utils.lockrank import RANKS
+
+# with-expression suffix -> rank name, for locks whose construction the
+# per-module scan can't attribute (cross-object attribute paths).
+WITH_EXPR_FALLBACK: dict[str, str] = {
+    "lane.lock": "mempool.lane",
+    "self._lanes[0].lock": "mempool.lane",
+}
+
+# Attribute-ish expressions that look like locks even when unranked —
+# L002 applies to these too (a blocking call under ANY lock is suspect).
+_LOCKISH = ("lock", "mtx", "mutex", "cond", "avail")
+
+# Blocking calls that must not run under a held lock. `.wait()` on the
+# with-target itself (a Condition) is exempt — that is the one blocking
+# call conditions exist to make.
+_BLOCKING_ATTRS = {"result", "join", "wait", "get", "recv", "accept"}
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - ast.unparse is total on py>=3.9
+        return "<expr>"
+
+
+def _ranked_call_name(node: ast.AST) -> str | None:
+    """The rank name if `node`'s subtree contains ranked_lock/_rlock("x")."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        fname = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if fname in ("ranked_lock", "ranked_rlock") and sub.args:
+            arg = sub.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                return arg.value
+    return None
+
+
+def _local_lock_map(tree: ast.AST) -> dict[str, str]:
+    """attr/name -> rank name, from ranked_lock assignment sites."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if value is None:
+            continue
+        rank_name = _ranked_call_name(value)
+        if rank_name is None:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute):
+                out[tgt.attr] = rank_name
+            elif isinstance(tgt, ast.Name):
+                out[tgt.id] = rank_name
+    return out
+
+
+class _Ctx:
+    __slots__ = ("expr", "rank_name", "rank")
+
+    def __init__(self, expr: str, rank_name: str | None):
+        self.expr = expr
+        self.rank_name = rank_name
+        self.rank = RANKS.get(rank_name) if rank_name else None
+
+
+def _resolve(expr: ast.AST, lock_map: dict[str, str]) -> _Ctx | None:
+    """Map a with-item expression to a lock context (None: not a lock)."""
+    text = _unparse(expr)
+    # module-local ranked assignment: self._wal_lock / bare names
+    if isinstance(expr, ast.Attribute) and expr.attr in lock_map:
+        return _Ctx(text, lock_map[expr.attr])
+    if isinstance(expr, ast.Name) and expr.id in lock_map:
+        return _Ctx(text, lock_map[expr.id])
+    for suffix, rank_name in WITH_EXPR_FALLBACK.items():
+        if text == suffix or text.endswith("." + suffix):
+            return _Ctx(text, rank_name)
+    tail = text.rsplit(".", 1)[-1].lower()
+    if any(t in tail for t in _LOCKISH):
+        return _Ctx(text, None)  # lock-looking but unranked
+    return None
+
+
+class LockOrderRule:
+    """L001: nested `with lock:` acquisitions must ascend the rank table."""
+
+    code = "L001"
+    description = (
+        "nested lock acquisition out of declared rank order "
+        "(utils/lockrank.py RANKS)"
+    )
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return src.tree is not None
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        lock_map = _local_lock_map(src.tree)
+        findings: list[Finding] = []
+        self._walk_body(src, src.tree, [], lock_map, findings)
+        return findings
+
+    def _walk_body(self, src, node, held: list[_Ctx], lock_map, findings):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                entered: list[_Ctx] = []
+                for item in child.items:
+                    ctx = _resolve(item.context_expr, lock_map)
+                    if ctx is None or ctx.rank is None:
+                        continue
+                    for outer in held + entered:
+                        if outer.rank is None:
+                            continue
+                        if ctx.rank < outer.rank or (
+                            ctx.rank == outer.rank
+                            and ctx.rank_name != outer.rank_name
+                        ):
+                            findings.append(
+                                src.finding(
+                                    self.code,
+                                    child.lineno,
+                                    f"acquires {ctx.rank_name!r} (rank "
+                                    f"{ctx.rank}) while holding "
+                                    f"{outer.rank_name!r} (rank {outer.rank})"
+                                    " — declared order is ascending rank",
+                                )
+                            )
+                    entered.append(ctx)
+                self._walk_body(src, child, held + entered, lock_map, findings)
+            elif isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                # a nested def is not executed under the lock at this site
+                self._walk_body(src, child, [], lock_map, findings)
+            else:
+                self._walk_body(src, child, held, lock_map, findings)
+
+
+class BlockingUnderLockRule:
+    """L002: no blocking call inside a lock body.
+
+    time.sleep, socket/endpoint recv/accept, future `.result()`,
+    thread `.join()`, and zero-positional-arg `.get()` / `.wait()`
+    calls (queue/event blocking reads) are flagged when lexically
+    inside a `with <lock>:` body. A Condition waiting on itself
+    (`with self._cond: self._cond.wait()`) is exempt.
+    """
+
+    code = "L002"
+    description = "blocking call while holding a lock"
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return src.tree is not None
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        lock_map = _local_lock_map(src.tree)
+        findings: list[Finding] = []
+        self._walk(src, src.tree, [], lock_map, findings)
+        return findings
+
+    def _walk(self, src, node, held: list[_Ctx], lock_map, findings):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                entered = [
+                    ctx
+                    for item in child.items
+                    if (ctx := _resolve(item.context_expr, lock_map))
+                    is not None
+                ]
+                self._walk(src, child, held + entered, lock_map, findings)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk(src, child, [], lock_map, findings)
+            else:
+                if held and isinstance(child, ast.Call):
+                    self._check_call(src, child, held, findings)
+                self._walk(src, child, held, lock_map, findings)
+
+    def _check_call(self, src, call: ast.Call, held: list[_Ctx], findings):
+        fn = call.func
+        lock_names = ", ".join(
+            c.rank_name or c.expr for c in held
+        )
+        # time.sleep(...)
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr == "sleep"
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "time"
+        ):
+            findings.append(
+                src.finding(
+                    self.code,
+                    call.lineno,
+                    f"time.sleep() while holding [{lock_names}]",
+                )
+            )
+            return
+        if not isinstance(fn, ast.Attribute) or fn.attr not in _BLOCKING_ATTRS:
+            return
+        recv_text = _unparse(fn.value)
+        if fn.attr == "wait":
+            # `with self._cond: self._cond.wait()` is the condition idiom
+            if any(recv_text == c.expr for c in held):
+                return
+            findings.append(
+                src.finding(
+                    self.code,
+                    call.lineno,
+                    f"{recv_text}.wait() while holding [{lock_names}] "
+                    "(waiting on a foreign primitive under a lock)",
+                )
+            )
+            return
+        if fn.attr in ("join", "get") and call.args:
+            return  # str.join(iterable) / dict.get(key) — not blocking
+        if fn.attr in ("recv", "accept") and not _looks_io(recv_text):
+            return
+        findings.append(
+            src.finding(
+                self.code,
+                call.lineno,
+                f"{recv_text}.{fn.attr}() while holding [{lock_names}] "
+                "(blocking call under a lock)",
+            )
+        )
+
+
+def _looks_io(recv_text: str) -> bool:
+    tail = recv_text.rsplit(".", 1)[-1].lower()
+    return any(
+        t in tail for t in ("sock", "conn", "endpoint", "pipe", "client")
+    )

@@ -1,0 +1,95 @@
+"""T001: silent thread death / swallowed exceptions in loop bodies.
+
+A reactor receive loop or a thread's run() body that catches
+``except:`` (bare) or ``except Exception: pass`` does not crash — it
+silently stops doing its job, which in a consensus system means frames
+dropped, gossip wedged, or a dead reader nobody notices. The rule
+flags:
+
+* bare ``except:`` anywhere in the package (never acceptable — it also
+  swallows KeyboardInterrupt/SystemExit);
+* overbroad handlers (``Exception`` / ``BaseException``) whose body is
+  ONLY ``pass`` / ``continue`` (no logging, no scoring, no re-raise)
+  inside thread-loop scopes: functions named like run/_recv*/_send*/
+  *_loop/receive/_dispatch, or any method of a class named *Reactor*.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tendermint_tpu.analysis.engine import Finding, SourceFile
+
+_SCOPE_FN = re.compile(
+    r"^(run|receive|_dispatch|_recv.*|_send.*|.*_loop|_worker)$"
+)
+
+
+def _is_overbroad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    """Handler does nothing observable: only pass/continue/constant."""
+    for stmt in handler.body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring/ellipsis
+        return False
+    return True
+
+
+class SilentThreadDeathRule:
+    code = "T001"
+    description = (
+        "bare or silently-swallowing overbroad except in a thread "
+        "loop / reactor receive path"
+    )
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return src.tree is not None
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        self._walk(src, src.tree, in_scope=False, findings=findings)
+        return findings
+
+    def _walk(self, src, node, in_scope: bool, findings):
+        for child in ast.iter_child_nodes(node):
+            scope = in_scope
+            if isinstance(child, ast.ClassDef):
+                scope = child.name.endswith("Reactor")
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = in_scope or bool(_SCOPE_FN.match(child.name))
+            elif isinstance(child, ast.ExceptHandler):
+                if child.type is None:
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            child.lineno,
+                            "bare `except:` swallows everything including "
+                            "KeyboardInterrupt — catch a concrete type",
+                        )
+                    )
+                elif in_scope and _is_overbroad(child) and _is_silent(child):
+                    findings.append(
+                        src.finding(
+                            self.code,
+                            child.lineno,
+                            "overbroad except silently swallowed in a "
+                            "thread-loop scope — a dying reader/reactor "
+                            "must log, score, or re-raise",
+                        )
+                    )
+            self._walk(src, child, scope, findings)

@@ -1,0 +1,119 @@
+"""W001: wire back-compat — new codec fields must be trailing-optional.
+
+The repo's compatibility discipline (PR 7 trace blocks, PR 9 block
+evidence): a decoder reads its mandatory fields unconditionally, then
+an OPTIONAL tail region guarded by remaining-length checks (`if not
+r.done():`) or try/except. Once a decoder enters the optional region,
+every later read must also be guarded — an unguarded read after a
+guarded one means a new MANDATORY field was appended behind optional
+ones, which breaks old encoders (they never write it) and old decoders
+(they misparse the tail).
+
+Scope: functions named like parse_* / decode_* / *from_wire* in any
+module; "reads" are calls to Reader methods (uvarint/svarint/bytes/
+string/bool/raw) or the module-level decode_* helpers.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tendermint_tpu.analysis.engine import Finding, SourceFile
+
+_SCOPE_FN = re.compile(r"^(parse_.*|decode_.*|.*from_wire.*|decode_wire)$")
+_READ_METHODS = {"uvarint", "svarint", "bytes", "string", "bool", "raw"}
+_READ_FNS = re.compile(r"^decode_[a-z_]+$")
+
+
+def _reads_in(node: ast.AST) -> list[ast.Call]:
+    out = []
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        fn = sub.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _READ_METHODS:
+            out.append(sub)
+        elif isinstance(fn, ast.Name) and _READ_FNS.match(fn.id):
+            out.append(sub)
+    return out
+
+
+def _guarded_lines(stmt: ast.stmt) -> set[int]:
+    """Line numbers of reads nested under an If/Try BODY inside `stmt`.
+
+    Reads in an `if` TEST are validation (`if r.uvarint() != MSG: raise`)
+    — mandatory, not optional-tail; only the bodies (and try handlers)
+    constitute the guarded optional region."""
+    guarded: set[int] = set()
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.If):
+            parts = sub.body + sub.orelse
+        elif isinstance(sub, ast.Try):
+            parts = sub.body + sub.orelse + sub.finalbody
+            for h in sub.handlers:
+                parts = parts + h.body
+        else:
+            continue
+        for part in parts:
+            for call in _reads_in(part):
+                guarded.add(call.lineno)
+    return guarded
+
+
+class TrailingOptionalRule:
+    code = "W001"
+    description = (
+        "unguarded wire read after the optional tail region — new codec "
+        "fields must be trailing-optional"
+    )
+
+    def applies_to(self, src: SourceFile) -> bool:
+        return src.tree is not None
+
+    def check(self, src: SourceFile) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _SCOPE_FN.match(node.name):
+                continue
+            self._check_fn(src, node, findings)
+        return findings
+
+    def _check_fn(self, src, fn, findings):
+        in_optional_tail = False
+        for stmt in fn.body:
+            reads = _reads_in(stmt)
+            if not reads:
+                continue
+            guarded = _guarded_lines(stmt)
+            # an If/Try statement whose reads are all inside it opens
+            # (or continues) the optional tail region
+            unguarded = [c for c in reads if c.lineno not in guarded]
+            if in_optional_tail and unguarded:
+                findings.append(
+                    src.finding(
+                        self.code,
+                        unguarded[0].lineno,
+                        f"{fn.name}(): unconditional wire read after the "
+                        "optional tail began — append new fields as "
+                        "guarded trailing-optional reads instead",
+                    )
+                )
+                # keep scanning; each unguarded-after-optional read in a
+                # later statement gets its own finding
+            if guarded and not unguarded:
+                in_optional_tail = True
+
+
+def decoder_functions(src: SourceFile) -> list[str]:
+    """Names of in-scope decoder functions (docs/debugging helper)."""
+    if src.tree is None:
+        return []
+    return [
+        n.name
+        for n in ast.walk(src.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and _SCOPE_FN.match(n.name)
+    ]

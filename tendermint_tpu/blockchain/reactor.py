@@ -435,6 +435,7 @@ class BlockchainReactor(Reactor):
             else:
                 try:
                     entry["handle"].result()
+                # tmlint: disable=T001 -- stale-suffix drain: joined only to release the dispatch slot, the failure was already handled upstream
                 except Exception:
                     pass
 

@@ -63,6 +63,7 @@ from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
 from tendermint_tpu.utils.fail import fail_point
+from tendermint_tpu.utils.lockrank import ranked_rlock
 from tendermint_tpu.utils import log as _log_mod
 import logging as _logging
 
@@ -125,7 +126,11 @@ class ConsensusState:
 
         self._queue: "queue.Queue" = queue.Queue()
         self._vote_dispatch = None  # lazy DispatchQueue for vote preverify
-        self._mtx = threading.RLock()
+        # The lowest-ranked lock in the process (lockrank
+        # "consensus.state"): held across mempool update/lock, evidence
+        # admission, and verify-spine joins, so everything it reaches
+        # must rank above it.
+        self._mtx = ranked_rlock("consensus.state")
         self._thread: threading.Thread | None = None
         self._running = False
         # Set when an internal invariant/persistence failure halts the
@@ -297,6 +302,7 @@ class ConsensusState:
                 for entry in pending:
                     try:
                         entry[1].result()
+                    # tmlint: disable=T001 -- shutdown slot-release join: verdicts are discarded by design, votes replay from the WAL
                     except Exception:
                         pass
                 return

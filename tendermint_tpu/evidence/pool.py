@@ -32,6 +32,7 @@ from tendermint_tpu.telemetry.flightrec import FLIGHT
 from tendermint_tpu.types.errors import ValidationError
 from tendermint_tpu.types.evidence import decode_evidence
 from tendermint_tpu.types.params import EvidenceParams
+from tendermint_tpu.utils.lockrank import ranked_rlock
 from tendermint_tpu.utils.log import kv, logger
 import logging
 
@@ -80,7 +81,10 @@ class EvidencePool:
         self.best_height_fn = best_height_fn
         # fires with the freshly added evidence (the reactor broadcasts)
         self.on_evidence_added = None
-        self._lock = threading.RLock()
+        # ranked just above consensus.state: _found_conflicting_votes
+        # admits evidence while holding the consensus lock; the gossip
+        # hook fires OUTSIDE this lock (see add_evidence)
+        self._lock = ranked_rlock("evidence.pool")
         self._pending: "OrderedDict[bytes, object]" = OrderedDict()
         self._committed: "OrderedDict[bytes, None]" = OrderedDict()
         self._wal_path = wal_path

@@ -54,6 +54,7 @@ from tendermint_tpu.abci.types import CodeType, Result
 from tendermint_tpu.services.batcher import consumer_kwargs
 from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.utils.lockrank import ranked_lock
 
 SIGNED_TX_MAGIC = b"\xed\x01"
 _PK_LEN = 32
@@ -152,7 +153,10 @@ class IngressBatcher:
                 os.environ.get("TENDERMINT_TPU_INGRESS_MAX_BATCH", "1024")
             )
         self._max_batch = max(1, max_batch)
-        self._cond = threading.Condition()
+        # Non-reentrant by construction (every `with self._cond:` block
+        # is self-contained); ranked BELOW the lane locks — the joiner
+        # runs admissions with no window lock held.
+        self._cond = threading.Condition(ranked_lock("mempool.ingress"))
         self._queue: "deque[_Admission]" = deque()
         self._barrier = False
         self._running = False

@@ -38,6 +38,7 @@ from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.types.tx import Tx, Txs, tx_hash
+from tendermint_tpu.utils.lockrank import ranked_lock, ranked_rlock
 
 DEFAULT_CACHE_SIZE = 100_000
 
@@ -59,7 +60,7 @@ class TxCache:
     def __init__(self, size: int = DEFAULT_CACHE_SIZE) -> None:
         self._size = size
         self._map: OrderedDict[bytes, None] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("mempool.txcache")
 
     def push(self, tx: bytes) -> bool:
         """False if already present (and does not re-add)."""
@@ -95,8 +96,10 @@ class _Lane:
 
     __slots__ = ("lock", "txs", "cache")
 
-    def __init__(self, cache_size: int) -> None:
-        self.lock = threading.RLock()
+    def __init__(self, cache_size: int, seq: int = 0) -> None:
+        # seq = lane index: the lockrank sanitizer allows same-rank lane
+        # acquisitions only in ascending index order (what lock() does)
+        self.lock = ranked_rlock("mempool.lane", seq=seq)
         self.txs: list[MempoolTx] = []
         self.cache = TxCache(cache_size)
 
@@ -152,9 +155,9 @@ class Mempool:
         self._app = app_conn
         n_lanes = _resolve_lanes(lanes)
         per_lane_cache = max(1, cache_size // n_lanes)
-        self._lanes = [_Lane(per_lane_cache) for _ in range(n_lanes)]
+        self._lanes = [_Lane(per_lane_cache, seq=i) for i in range(n_lanes)]
         self._counter = 0
-        self._counter_lock = threading.Lock()
+        self._counter_lock = ranked_lock("mempool.counter")
         self._height = height
         self._recheck = recheck
         # Bumped by flush() while every lane lock is held; admissions
@@ -169,9 +172,11 @@ class Mempool:
         # insert under the lane lock, RELEASE it, then notify. The
         # once-per-height "txs available" latch has its own tiny lock so
         # update() (holding every lane lock via lock()) never touches
-        # _avail either.
-        self._avail = threading.Condition(threading.Lock())
-        self._notif_lock = threading.Lock()
+        # _avail either. This order is NORMATIVE: utils/lockrank.py's
+        # RANKS table encodes it, tmlint L001 checks it statically, and
+        # the ranked locks below enforce it at test time.
+        self._avail = threading.Condition(ranked_lock("mempool.avail"))
+        self._notif_lock = ranked_lock("mempool.notif")
         self._notified_available = False
         self._fire_available: Callable[[], None] | None = None
         # distributed tracing: who minted (span attr `node`) + the
@@ -179,7 +184,7 @@ class Mempool:
         # commit-time tx.e2e observation read
         self._node_id = node_id
         self._traces: "OrderedDict[bytes, tuple[object, float]]" = OrderedDict()
-        self._trace_lock = threading.Lock()
+        self._trace_lock = ranked_lock("mempool.trace")
         self._wal = None
         # Appends are length-framed; concurrent RPC + gossip admissions
         # used to interleave partial writes and corrupt the framing
@@ -188,7 +193,7 @@ class Mempool:
         # record are produced under one _wal_lock hold, so WAL order ==
         # counter (admission) order, which replay for nonce-style serial
         # apps relies on.
-        self._wal_lock = threading.Lock()
+        self._wal_lock = ranked_lock("mempool.wal")
         if wal_dir:
             os.makedirs(wal_dir, exist_ok=True)
             self._wal = open(os.path.join(wal_dir, "wal"), "ab")

@@ -15,7 +15,15 @@ TraceContext wire encoding. Codec-backward-compatible by construction:
 a frame without it is byte-identical to the old format and decodes
 unchanged; a receiver that doesn't know the block ignores trailing
 bytes; a decode failure drops the context (counted), never the frame.
-Sampled-out messages carry no context bytes at all.
+Sampled-out messages carry no context bytes at all. (tmlint rule W001
+checks this trailing-optional discipline statically for every decoder.)
+
+Concurrency: this class is deliberately LOCK-FREE — channels are
+`queue.Queue`s, wakeups are Events, and per-link state is owned by the
+send/recv/ping threads. The locks it leans on live in its
+collaborators and rank near the top of the utils/lockrank.py table:
+the flowrate monitors ("p2p.flowrate") and the endpoint write locks
+("p2p.conn.write"), both leaves acquired under nothing else.
 """
 
 from __future__ import annotations

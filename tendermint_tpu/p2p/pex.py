@@ -209,8 +209,9 @@ class PEXReactor(Reactor):
             self.book.mark_attempt(addr.node_id)
             try:
                 peer = self._dial(addr)
+            # tmlint: disable=T001 -- dial failures are normal churn: mark_attempt already recorded it and the book evicts flaky addresses
             except Exception:
-                continue  # attempts counter already bumped; book evicts flakes
+                continue
             if peer is None:
                 continue  # dial_fn signalled failure: stays unproven
             # promote ONLY if the authenticated identity matches the book

@@ -22,6 +22,8 @@ from __future__ import annotations
 import threading
 import time
 
+from tendermint_tpu.utils.lockrank import ranked_lock
+
 
 class PeerMisbehavior(Exception):
     """A typed peer-fault signal: carried from the connection layer (bad
@@ -68,7 +70,7 @@ class PeerScorer:
         self.half_life_s = max(1e-3, half_life_s)
         self.ban_duration_s = ban_duration_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("p2p.scorer")
         self._scores: dict[str, tuple[float, float]] = {}  # id -> (score, at)
         self._bans: dict[str, float] = {}  # id -> ban expiry
 

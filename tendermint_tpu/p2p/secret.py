@@ -28,6 +28,7 @@ from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
 from tendermint_tpu.crypto.keys import PrivKey, PubKey
 from tendermint_tpu.p2p.transport import EndpointClosed
+from tendermint_tpu.utils.lockrank import ranked_lock
 
 _TRANSCRIPT_PREFIX = b"tendermint_tpu/secret-connection/v1"
 
@@ -57,7 +58,7 @@ class SecretEndpoint:
         self.remote_pub_key: PubKey | None = None
         self._send_nonce = 0
         self._recv_nonce = 0
-        self._send_lock = threading.Lock()
+        self._send_lock = ranked_lock("p2p.conn.write")
         self._handshake(priv_key)
 
     # -- handshake ---------------------------------------------------------

@@ -18,6 +18,7 @@ from tendermint_tpu.p2p.peer import NodeInfo, Peer
 from tendermint_tpu.p2p.score import PeerMisbehavior, PeerScorer
 from tendermint_tpu.p2p.transport import Endpoint, pipe_pair
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.utils.lockrank import ranked_rlock
 from tendermint_tpu.utils.log import kv, logger
 
 
@@ -55,8 +56,11 @@ class Switch:
         self._reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._descriptors: list[ChannelDescriptor] = []
+        # Leaf lock by design: held only over _peers dict surgery, never
+        # across reactor callbacks, peer.start/stop, or sends (lockrank
+        # "p2p.switch" — near the top of the rank table).
         self._peers: dict[str, Peer] = {}
-        self._mtx = threading.RLock()
+        self._mtx = ranked_rlock("p2p.switch")
         self._running = False
         self.listen_addr = node_info.listen_addr  # set once the listener binds
         # per-peer flow caps, bytes/s (0 = unlimited; reference 500 kB/s)

@@ -19,6 +19,7 @@ import threading
 from tendermint_tpu.p2p.peer import NodeInfo
 from tendermint_tpu.p2p.switch import Switch
 from tendermint_tpu.p2p.transport import EndpointClosed
+from tendermint_tpu.utils.lockrank import ranked_lock
 
 _MAX_FRAME = 8 * 1024 * 1024
 
@@ -28,7 +29,7 @@ class TcpEndpoint:
 
     def __init__(self, sock: socket.socket) -> None:
         self._sock = sock
-        self._wlock = threading.Lock()
+        self._wlock = ranked_lock("p2p.conn.write")
         self._closed = threading.Event()
         try:
             host, port = sock.getpeername()[:2]

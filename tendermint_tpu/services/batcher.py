@@ -58,6 +58,7 @@ from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
+from tendermint_tpu.utils.lockrank import ranked_lock
 
 CACHE_SIZE = int(os.environ.get("TENDERMINT_TPU_VERIFY_CACHE_SIZE", "65536"))
 MAX_COALESCED_BATCH = int(
@@ -109,7 +110,8 @@ class VerifiedSigCache:
         per_shard = max(1, self.capacity // self.SHARDS)
         self._per_shard = per_shard
         self._shards = [
-            (threading.Lock(), OrderedDict()) for _ in range(self.SHARDS)
+            (ranked_lock("batcher.shard", seq=i), OrderedDict())
+            for i in range(self.SHARDS)
         ]
         self.enabled = self.capacity > 0
 
@@ -302,7 +304,9 @@ class VerifyCoalescer:
         self._fixed_window = window_s
         self._window_s = window_s if window_s is not None else 0.002
         self._depth = depth
-        self._cond = threading.Condition()
+        # Non-reentrant usage throughout; ranked above the handle locks
+        # (sub-handle joins may poke the window under a handle join).
+        self._cond = threading.Condition(ranked_lock("batcher.window"))
         self._queues: "dict[str, deque[_Request]]" = {}
         self._pending_triples = 0
         self._barrier = False

@@ -45,6 +45,7 @@ from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
+from tendermint_tpu.utils.lockrank import ranked_lock
 
 # In-flight launches per queue (submitted, not yet joined). 2 is the
 # classic double-buffer: one launch on device, one window of host prep.
@@ -98,7 +99,7 @@ class VerifyHandle:
         self._value = None
         self._exc: BaseException | None = None
         self._finalized = False
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("dispatch.handle")
         self._submitted_at = time.perf_counter()
         self._launched_at: float | None = None
         # trace context ambient on the SUBMITTING thread — the worker
@@ -203,18 +204,29 @@ class ChainedHandle:
         self._value = None
         self._exc: BaseException | None = None
         self._done = False
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("dispatch.handle")
         self.kind = getattr(parent, "kind", "verify")
 
     def done(self) -> bool:
         return self._parent.done()
 
     def result(self, timeout: float | None = None):
+        # Join the parent BEFORE taking our lock (tmlint L002): parent
+        # joins are idempotent and cache their outcome, so concurrent
+        # joiners may all block here, but none blocks while holding
+        # this handle's lock.
+        try:
+            parent_value = self._parent.result(timeout)
+            parent_exc: BaseException | None = None
+        except BaseException as e:
+            parent_exc = e
         with self._lock:
             if not self._done:
                 self._done = True
                 try:
-                    self._value = self._fn(self._parent.result(timeout))
+                    if parent_exc is not None:
+                        raise parent_exc
+                    self._value = self._fn(parent_value)
                 except BaseException as e:
                     self._exc = e
                 finally:
@@ -267,8 +279,8 @@ class DispatchQueue:
         self._sem = threading.Semaphore(self.depth)
         self._work: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()
         self._thread: threading.Thread | None = None
-        self._thread_lock = threading.Lock()
-        self._state_lock = threading.Lock()
+        self._thread_lock = ranked_lock("dispatch.worker")
+        self._state_lock = ranked_lock("dispatch.state")
         self._inflight = 0
         self._closed = False
 
@@ -362,7 +374,7 @@ def measured_launch_apply_ratio(queue: str | None = None) -> float | None:
 
 
 _DEFAULT_QUEUE: DispatchQueue | None = None
-_DEFAULT_LOCK = threading.Lock()
+_DEFAULT_LOCK = ranked_lock("dispatch.global")
 
 
 def default_dispatch_queue() -> DispatchQueue:

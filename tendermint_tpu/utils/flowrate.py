@@ -7,6 +7,8 @@ from __future__ import annotations
 import threading
 import time
 
+from tendermint_tpu.utils.lockrank import ranked_lock
+
 
 class Monitor:
     """Byte-rate tracker with an exponential moving average, plus an
@@ -22,7 +24,7 @@ class Monitor:
         self.limit = limit_bytes_per_s
         self._window = window_s
         self._time = time_fn
-        self._lock = threading.Lock()
+        self._lock = ranked_lock("p2p.flowrate")
         self._total = 0
         self._rate = 0.0
         self._bucket = 0

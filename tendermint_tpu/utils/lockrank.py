@@ -1,0 +1,424 @@
+"""Runtime lock-rank sanitizer: the dynamic half of tmlint.
+
+The Go reference keeps its concurrency spine honest with `go test
+-race`; Python has no race detector, so this module enforces the next
+best thing — a **declared lock acquisition order** — at test time.
+`RANKS` below is the normative table (docs/STATIC_ANALYSIS.md): locks
+must be acquired in ascending rank order, and same-rank locks (the
+mempool lanes, the sig-cache shards) only in ascending `seq` order.
+The PR 8 mempool discipline `lane -> _wal_lock -> _counter_lock` is
+rows 40 -> 48 -> 52 of this table.
+
+Two detectors run on every instrumented acquire:
+
+* **Rank inversion** — acquiring a lock whose rank is <= the highest
+  rank this thread already holds (same-rank + ascending seq excepted).
+  Caught BEFORE the blocking acquire, so a deliberate ABBA deadlock is
+  reported instead of hung.
+* **Order-graph cycles** — every first-time edge ``held -> acquired``
+  enters a process-global directed graph with the acquiring thread's
+  stack; an edge that closes a cycle is a potential deadlock even when
+  every participating lock is unranked (rank=None). The report carries
+  the acquisition stacks of BOTH sides of the cycle — the
+  flight-recorder-style dump the acceptance criteria require.
+
+Zero-overhead discipline: `ranked_lock()` / `ranked_rlock()` return
+plain `threading.Lock()` / `RLock()` objects unless
+``TENDERMINT_TPU_LOCKRANK=1`` (tests/conftest.py sets it for the whole
+tier-1 run), so production hot paths never pay for the bookkeeping.
+
+Violations are RECORDED by default (``violations()`` / ``drain()``;
+the conftest autouse fixture turns them into test failures carrying
+``render_report()``). ``TENDERMINT_TPU_LOCKRANK_RAISE=1`` or
+``set_raise(True)`` raises `LockRankViolation` at the offending acquire
+instead — which is what lets an ABBA regression test run to completion
+without deadlocking.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+# -- the declared rank table (normative; see docs/STATIC_ANALYSIS.md) --------
+#
+# Ascending rank == legal acquisition order. Gaps are deliberate so new
+# locks slot in without renumbering. tmlint rule L001 statically checks
+# nested `with` blocks against this same table.
+RANKS: dict[str, int] = {
+    # consensus holds its big lock across mempool/evidence/dispatch work,
+    # so it is the lowest-ranked lock in the process.
+    "consensus.state": 10,  # ConsensusState._mtx
+    "evidence.pool": 20,  # EvidencePool._lock
+    # mempool (PR 8 order made normative): _avail -> lanes -> wal -> counter
+    "mempool.avail": 30,  # Mempool._avail's Condition lock
+    "mempool.ingress": 35,  # IngressBatcher._cond's lock
+    "mempool.lane": 40,  # _Lane.lock (seq = lane index)
+    "mempool.txcache": 44,  # TxCache._lock (under lanes via recheck/flush)
+    "mempool.wal": 48,  # Mempool._wal_lock
+    "mempool.counter": 52,  # Mempool._counter_lock
+    "mempool.notif": 56,  # Mempool._notif_lock (under all lanes in update())
+    "mempool.trace": 60,  # Mempool._trace_lock
+    # verify spine
+    "dispatch.handle": 64,  # VerifyHandle/ChainedHandle._lock
+    "batcher.shard": 68,  # VerifiedSigCache shard locks (seq = shard index)
+    "batcher.window": 72,  # VerifyCoalescer._cond's lock
+    "dispatch.worker": 76,  # DispatchQueue._thread_lock
+    "dispatch.state": 80,  # DispatchQueue._state_lock
+    "dispatch.global": 84,  # default_dispatch_queue singleton lock
+    # p2p locks are leaves: held only over dict/counter surgery, never
+    # across reactor callbacks or sends.
+    "p2p.switch": 88,  # Switch._mtx
+    "p2p.scorer": 90,  # PeerScorer._lock
+    "p2p.conn.write": 92,  # tcp/secret per-connection write locks
+    "p2p.flowrate": 94,  # flowrate.Monitor._lock
+}
+
+_ENV = "TENDERMINT_TPU_LOCKRANK"
+_ENV_RAISE = "TENDERMINT_TPU_LOCKRANK_RAISE"
+
+_STACK_FRAMES = 14  # per-side stack depth kept in edge/violation reports
+
+
+class LockRankViolation(RuntimeError):
+    """Raised (in raise mode) before the offending acquire blocks."""
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV, "0") not in ("", "0")
+
+
+_raise_mode: bool | None = None
+
+
+def _should_raise() -> bool:
+    if _raise_mode is not None:
+        return _raise_mode
+    return os.environ.get(_ENV_RAISE, "0") not in ("", "0")
+
+
+def set_raise(on: bool | None) -> None:
+    """Force raise mode on/off; None restores the env-var default."""
+    global _raise_mode
+    _raise_mode = on
+
+
+# -- process-global state -----------------------------------------------------
+
+
+class _TLS(threading.local):
+    def __init__(self) -> None:
+        self.stack: list[_Held] = []
+
+
+_tls = _TLS()
+
+_graph_lock = threading.Lock()
+# edge (from_name, to_name) -> first-observation record
+_edges: dict[tuple[str, str], dict] = {}
+# adjacency for cycle detection (names)
+_adj: dict[str, set[str]] = {}
+
+_viol_lock = threading.Lock()
+_violations: list[dict] = []
+
+
+class _Held:
+    __slots__ = ("lock", "count")
+
+    def __init__(self, lock: "RankedLock") -> None:
+        self.lock = lock
+        self.count = 1
+
+
+def _capture_stack() -> list[str]:
+    # skip this helper + the sanitizer frames above it
+    return traceback.format_list(
+        traceback.extract_stack(limit=_STACK_FRAMES + 3)[:-3]
+    )
+
+
+def _record_violation(kind: str, message: str, stacks: list[dict]) -> None:
+    v = {
+        "kind": kind,
+        "message": message,
+        "thread": threading.current_thread().name,
+        "stacks": stacks,
+    }
+    with _viol_lock:
+        _violations.append(v)
+    if _should_raise():
+        raise LockRankViolation(message + "\n" + render_violation(v))
+
+
+def violations() -> list[dict]:
+    with _viol_lock:
+        return list(_violations)
+
+
+def drain() -> list[dict]:
+    """Return and clear recorded violations (the conftest fixture)."""
+    with _viol_lock:
+        out = list(_violations)
+        _violations.clear()
+    return out
+
+
+def reset() -> None:
+    """Clear violations AND the learned order graph (test isolation)."""
+    with _viol_lock:
+        _violations.clear()
+    with _graph_lock:
+        _edges.clear()
+        _adj.clear()
+
+
+def render_violation(v: dict) -> str:
+    lines = [f"[{v['kind']}] {v['message']} (thread {v['thread']})"]
+    for s in v["stacks"]:
+        lines.append(f"  -- {s['label']} (thread {s['thread']}):")
+        for frame in s["stack"]:
+            for ln in frame.rstrip().splitlines():
+                lines.append("    " + ln)
+    return "\n".join(lines)
+
+
+def render_report() -> str:
+    """Flight-recorder-style dump of every recorded violation."""
+    vs = violations()
+    if not vs:
+        return "lockrank: no violations recorded"
+    out = [f"lockrank: {len(vs)} violation(s)"]
+    for i, v in enumerate(vs):
+        out.append(f"--- violation {i + 1} ---")
+        out.append(render_violation(v))
+    return "\n".join(out)
+
+
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """DFS path src -> dst in the order graph (caller holds _graph_lock)."""
+    seen = {src}
+    stack = [(src, [src])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in _adj.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_edge(held: "RankedLock", acquiring: "RankedLock") -> None:
+    """Record held -> acquiring in the order graph; a new edge that
+    closes a cycle is a potential deadlock even if every lock is
+    unranked (the classic ABBA shows up here as a 2-cycle)."""
+    edge = (held.name, acquiring.name)
+    if edge[0] == edge[1]:
+        return  # same-name pairs (lanes, shards) are seq-checked instead
+    if edge in _edges:
+        return  # lock-free fast path: dict reads are GIL-atomic, and a
+        # stale miss just falls through to the locked re-check below
+    with _graph_lock:
+        if edge in _edges:
+            return
+        back_path = _find_path(acquiring.name, held.name)
+        _edges[edge] = {
+            "thread": threading.current_thread().name,
+            "stack": _capture_stack(),
+        }
+        _adj.setdefault(edge[0], set()).add(edge[1])
+        if back_path is None:
+            return
+        # cycle: acquiring -> ... -> held exists, and we just added
+        # held -> acquiring. Collect the stacks of every edge on the
+        # reverse path plus this thread's — both sides of the deadlock.
+        stacks = [
+            {
+                "label": f"order {edge[0]} -> {edge[1]} (this acquire)",
+                "thread": threading.current_thread().name,
+                "stack": _capture_stack(),
+            }
+        ]
+        for a, b in zip(back_path, back_path[1:]):
+            rec = _edges.get((a, b))
+            if rec is not None:
+                stacks.append(
+                    {
+                        "label": f"order {a} -> {b} (first observed)",
+                        "thread": rec["thread"],
+                        "stack": rec["stack"],
+                    }
+                )
+        cycle = " -> ".join(back_path + [back_path[0]])
+    _record_violation(
+        "cycle",
+        f"lock-order cycle (potential deadlock): {cycle}",
+        stacks,
+    )
+
+
+class RankedLock:
+    """threading.Lock with rank/order instrumentation.
+
+    `rank=None` means unranked: the lock still participates in the
+    order graph (cycle detection) but skips the rank comparison.
+    """
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str, rank: int | None = None, seq: int = 0) -> None:
+        self.name = name
+        self.rank = RANKS.get(name) if rank is None else rank
+        self.seq = seq
+        self._inner = self._factory()
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _held_entry(self) -> _Held | None:
+        for h in _tls.stack:
+            if h.lock is self:
+                return h
+        return None
+
+    def _check(self) -> None:
+        stack = _tls.stack
+        if not stack:
+            return
+        inverted = False
+        if self.rank is not None:
+            worst: RankedLock | None = None
+            for h in stack:
+                r = h.lock.rank
+                if r is None:
+                    continue
+                bad = r > self.rank or (
+                    r == self.rank
+                    and h.lock is not self
+                    and h.lock.seq >= self.seq
+                )
+                if bad and (worst is None or r >= (worst.rank or 0)):
+                    worst = h.lock
+            if worst is not None:
+                inverted = True
+                _record_violation(
+                    "rank_inversion",
+                    f"acquiring {self.name!r} (rank {self.rank}, seq "
+                    f"{self.seq}) while holding {worst.name!r} (rank "
+                    f"{worst.rank}, seq {worst.seq}) — declared order is "
+                    f"ascending rank (see utils/lockrank.py RANKS)",
+                    [
+                        {
+                            "label": f"acquire of {self.name} "
+                            f"holding [{', '.join(h.lock.name for h in stack)}]",
+                            "thread": threading.current_thread().name,
+                            "stack": _capture_stack(),
+                        }
+                    ]
+                    + self._reverse_edge_stacks(worst),
+                )
+        if not inverted:
+            # an inversion is already the report; feeding it into the
+            # order graph would double-report it as a cycle too
+            self._note_edges()
+
+    def _reverse_edge_stacks(self, worst: "RankedLock") -> list[dict]:
+        """If the legal order (self -> worst) was ever observed, include
+        that thread's stack: the report then shows BOTH threads'
+        acquisition stacks for the inversion pair."""
+        with _graph_lock:
+            rec = _edges.get((self.name, worst.name))
+        if rec is None:
+            return []
+        return [
+            {
+                "label": f"order {self.name} -> {worst.name} (first observed)",
+                "thread": rec["thread"],
+                "stack": rec["stack"],
+            }
+        ]
+
+    def _note_edges(self) -> None:
+        # one edge per DISTINCT held lock name: the transitive pairs
+        # (lane -> counter with wal in between) matter both for cycle
+        # detection and for inversion reports quoting the legal-order
+        # thread's stack
+        seen: set[str] = set()
+        for h in _tls.stack:
+            if h.lock.name in seen:
+                continue
+            seen.add(h.lock.name)
+            _note_edge(h.lock, self)
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = self._held_entry()
+        if held is None:
+            self._check()
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if held is not None:
+                held.count += 1
+            else:
+                _tls.stack.append(_Held(self))
+        return ok
+
+    def release(self) -> None:
+        stack = _tls.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i].lock is self:
+                stack[i].count -= 1
+                if stack[i].count == 0:
+                    del stack[i]
+                break
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # Condition-variable integration: threading.Condition picks these up
+    # when present, so Condition(ranked_lock(...)) keeps correct owner
+    # semantics (and wait() pops/pushes the hold like any release/acquire).
+    def _is_owned(self) -> bool:
+        return self._held_entry() is not None
+
+    def _release_save(self):
+        held = self._held_entry()
+        n = held.count if held is not None else 1
+        for _ in range(n):
+            self.release()
+        return n
+
+    def _acquire_restore(self, n) -> None:
+        for _ in range(n):
+            self.acquire()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<RankedLock {self.name} rank={self.rank} seq={self.seq}>"
+
+
+class RankedRLock(RankedLock):
+    _factory = staticmethod(threading.RLock)
+
+
+def ranked_lock(name: str, rank: int | None = None, seq: int = 0):
+    """A Lock carrying `name`'s declared rank — or a plain
+    `threading.Lock` when the sanitizer is off (zero overhead)."""
+    if not enabled():
+        return threading.Lock()
+    return RankedLock(name, rank, seq)
+
+
+def ranked_rlock(name: str, rank: int | None = None, seq: int = 0):
+    if not enabled():
+        return threading.RLock()
+    return RankedRLock(name, rank, seq)

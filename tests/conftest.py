@@ -5,6 +5,14 @@ dry-runs multi-chip via __graft_entry__.dryrun_multichip).
 Note: this machine's axon sitecustomize registers the TPU plugin and
 overwrites `jax_platforms` — the env var alone is not enough, so we also
 update the config after importing jax (before any backend initialization).
+
+This file is also the tier-1 wiring for tmlint (tendermint_tpu/analysis/):
+the three original collection lints are thin shims over the engine's rules
+(M001 metric catalog, M002 span catalog, M003 kernel marks), the FULL rule
+set gates collection on the package + tools/, and the runtime lock-rank
+sanitizer (utils/lockrank.py) is enabled for the whole run — any rank
+inversion or lock-order cycle a test provokes fails that test with the
+acquisition-stack report.
 """
 
 import os
@@ -15,91 +23,64 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Lock-rank sanitizer on for the whole suite (before any tendermint_tpu
+# import constructs a lock). TENDERMINT_TPU_LOCKRANK=0 opts out locally.
+os.environ.setdefault("TENDERMINT_TPU_LOCKRANK", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import pathlib  # noqa: E402
+
 import pytest  # noqa: E402
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
 
 
 def lint_kernel_marks(items) -> list[str]:
-    """Marker lint: every `kernel`-marked test must ALSO be `slow`.
+    """Marker lint shim: every `kernel`-marked test must ALSO be `slow`
+    (tier-1 `-m 'not slow'` overrides pytest.ini's `-m 'not kernel'`;
+    see the ROADMAP tier-1 note). Logic lives in tmlint rule M003."""
+    from tendermint_tpu.analysis.rules_catalog import kernel_mark_offenders
 
-    Tier-1 selects `-m 'not slow'`, which OVERRIDES pytest.ini's
-    `-m 'not kernel'` — a kernel-only mark would pull ~20 min of XLA:CPU
-    kernel compiles into the fast lane and time the whole run out
-    (ROADMAP tier-1 note). Returns offending node ids."""
-    return [
-        item.nodeid
-        for item in items
-        if item.get_closest_marker("kernel") is not None
-        and item.get_closest_marker("slow") is None
-    ]
+    return kernel_mark_offenders(items)
 
 
 def lint_metric_catalog(roots=None) -> list[str]:
-    """Catalog lint: every `tendermint_*` metric name used as a string
+    """Catalog lint shim (tmlint M001): every `tendermint_*` metric
     literal in the package (and tools/) must be registered by
-    `telemetry/metrics.py` — an unregistered name means a dashboard or
-    invariant is querying a series that will never exist. Returns
-    `path:name` offenders. Histogram exposition suffixes
-    (`_bucket`/`_sum`/`_count`) resolve to their base family."""
-    import pathlib
-    import re
+    `telemetry/metrics.py`. Returns `path:name` offenders."""
+    from tendermint_tpu.analysis.rules_catalog import metric_offenders
 
-    import tendermint_tpu.telemetry.metrics  # noqa: F401 — fills the registry
-    from tendermint_tpu.telemetry import REGISTRY
-
-    repo = pathlib.Path(__file__).resolve().parents[1]
-    if roots is None:
-        roots = [repo / "tendermint_tpu", repo / "tools"]
-    registered = {m.name for m in REGISTRY.metrics()}
-    pat = re.compile(r"""["'](tendermint_[a-z0-9_]+)["']""")
-    offenders: list[str] = []
-    for root in roots:
-        for path in sorted(pathlib.Path(root).rglob("*.py")):
-            for name in pat.findall(path.read_text(encoding="utf-8")):
-                if name.startswith("tendermint_tpu"):
-                    continue  # the package name, not a metric
-                base = re.sub(r"_(bucket|sum|count)$", "", name)
-                if name in registered or base in registered:
-                    continue
-                try:
-                    shown = path.relative_to(repo)
-                except ValueError:  # lint tests point at tmp dirs
-                    shown = path
-                offenders.append(f"{shown}:{name}")
-    return offenders
+    return metric_offenders(roots)
 
 
 def lint_span_catalog(roots=None) -> list[str]:
-    """Span-name lint: every literal name passed to `TRACER.span("…")`
-    or `TRACER.add("…", …)` in the package (and tools/) must be
-    registered in `telemetry/metrics.py`'s SPAN_CATALOG — same
-    discipline as the metric lint: an uncataloged span name means a
-    timeline/dashboard query that silently matches nothing. Returns
-    `path:name` offenders."""
-    import pathlib
-    import re
+    """Span-name lint shim (tmlint M002): every literal passed to
+    `TRACER.span("…")` / `TRACER.add("…", …)` must be in
+    `telemetry/metrics.py`'s SPAN_CATALOG. Returns `path:name`
+    offenders."""
+    from tendermint_tpu.analysis.rules_catalog import span_offenders
 
-    from tendermint_tpu.telemetry.metrics import SPAN_CATALOG
+    return span_offenders(roots)
 
-    repo = pathlib.Path(__file__).resolve().parents[1]
-    if roots is None:
-        roots = [repo / "tendermint_tpu", repo / "tools"]
-    pat = re.compile(r"""TRACER\.(?:span|add)\(\s*["']([a-z0-9_.]+)["']""")
-    offenders: list[str] = []
-    for root in roots:
-        for path in sorted(pathlib.Path(root).rglob("*.py")):
-            for name in pat.findall(path.read_text(encoding="utf-8")):
-                if name in SPAN_CATALOG:
-                    continue
-                try:
-                    shown = path.relative_to(repo)
-                except ValueError:  # lint tests point at tmp dirs
-                    shown = path
-                offenders.append(f"{shown}:{name}")
-    return offenders
+
+def run_tmlint_gate() -> str | None:
+    """Full tmlint pass over the package + tools with the repo baseline;
+    returns the rendered report when it fails, None when clean. Gates
+    tier-1 collection so concurrency/wire/purity invariants cannot
+    regress silently (<2 s on the whole tree)."""
+    from tendermint_tpu.analysis import engine
+
+    report = engine.lint_paths(
+        [_REPO / "tendermint_tpu", _REPO / "tools"],
+        baseline_path=_REPO / "tools" / "tmlint_baseline.json",
+        root=_REPO,
+    )
+    if report.ok:
+        return None
+    return engine.render_report(report)
 
 
 def pytest_collection_modifyitems(config, items):
@@ -122,3 +103,34 @@ def pytest_collection_modifyitems(config, items):
             "span names recorded in code but missing from "
             "telemetry/metrics.py's SPAN_CATALOG: " + ", ".join(bad_spans[:10])
         )
+    tmlint_failure = run_tmlint_gate()
+    if tmlint_failure is not None:
+        raise pytest.UsageError(
+            "tmlint found repo-invariant violations (run `python -m "
+            "tools.tmlint` locally; suppress false positives with a "
+            "reasoned `# tmlint: disable=RULE -- why`):\n" + tmlint_failure
+        )
+
+
+@pytest.fixture(autouse=True)
+def _lockrank_guard():
+    """Turn lock-rank violations into failures of the test that
+    provoked them, carrying both threads' acquisition stacks. Violations
+    recorded by background threads between tests surface on the next
+    test — still loud, occasionally mis-attributed by one test."""
+    yield
+    from tendermint_tpu.utils import lockrank
+
+    violations = lockrank.drain()
+    if violations:
+        pytest.fail(
+            "lock-rank sanitizer recorded violation(s) during this test "
+            "(utils/lockrank.py):\n" + lockrank_render(violations),
+            pytrace=False,
+        )
+
+
+def lockrank_render(violations) -> str:
+    from tendermint_tpu.utils import lockrank
+
+    return "\n".join(lockrank.render_violation(v) for v in violations)

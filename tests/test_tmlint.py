@@ -1,0 +1,287 @@
+"""tmlint engine self-tests: per-rule fixture corpus (good files stay
+clean, bad files produce exactly the expected findings), suppression
+semantics (reasoned suppressions hide, reasonless ones are S001),
+baseline add/remove semantics, CLI exit codes, and the --changed mode's
+file selection. All marked `lint` (pytest.ini) so the engine's own
+coverage is selectable with -m lint while staying in tier-1."""
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu.analysis import engine
+
+pytestmark = pytest.mark.lint
+
+REPO = engine.repo_root()
+FIXTURES = REPO / "tendermint_tpu" / "analysis" / "fixtures"
+
+
+def lint_fixture(name: str, rules: list[str]) -> list[engine.Finding]:
+    report = engine.lint_paths([FIXTURES / name], rules=rules)
+    return report.findings
+
+
+class TestRuleFixtures:
+    def test_l001_bad_flags_both_sites(self):
+        findings = lint_fixture("L001_bad.py", ["L001"])
+        assert len(findings) == 2
+        assert all(f.rule == "L001" for f in findings)
+        assert "mempool.wal" in findings[0].message
+        assert "mempool.counter" in findings[0].message
+
+    def test_l001_good_is_clean(self):
+        assert lint_fixture("L001_good.py", ["L001"]) == []
+
+    def test_l002_bad_flags_every_blocking_call(self):
+        findings = lint_fixture("L002_bad.py", ["L002"])
+        msgs = "\n".join(f.message for f in findings)
+        assert "time.sleep" in msgs
+        assert ".result" in msgs or "result()" in msgs
+        assert "join" in msgs
+        assert "get" in msgs
+        assert "wait" in msgs
+        assert len(findings) == 5
+
+    def test_l002_good_is_clean(self):
+        assert lint_fixture("L002_good.py", ["L002"]) == []
+
+    def test_t001_bad_flags_bare_and_silent(self):
+        findings = lint_fixture("T001_bad.py", ["T001"])
+        assert len(findings) == 4  # bare + reactor + run + _recv_loop
+        assert any("bare" in f.message for f in findings)
+
+    def test_t001_good_is_clean(self):
+        assert lint_fixture("T001_good.py", ["T001"]) == []
+
+    def test_w001_bad_flags_reads_after_tail(self):
+        findings = lint_fixture("W001_bad.py", ["W001"])
+        assert len(findings) == 2
+        assert all("trailing-optional" in f.message for f in findings)
+
+    def test_w001_good_is_clean(self):
+        assert lint_fixture("W001_good.py", ["W001"]) == []
+
+    def test_j001_bad_flags_effects_and_branches(self):
+        findings = lint_fixture("J001_bad.py", ["J001"])
+        msgs = "\n".join(f.message for f in findings)
+        assert "print" in msgs
+        assert "time.time" in msgs
+        assert "branch on traced" in msgs.lower()
+        assert len(findings) == 4
+
+    def test_j001_good_is_clean(self):
+        assert lint_fixture("J001_good.py", ["J001"]) == []
+
+    def test_m001_bad_flags_only_the_unregistered_name(self):
+        findings = lint_fixture("M001_bad.py", ["M001"])
+        assert len(findings) == 1
+        assert "tendermint_not_in_the_catalog_total" in findings[0].message
+
+    def test_m002_bad_flags_only_the_uncataloged_span(self):
+        findings = lint_fixture("M002_bad.py", ["M002"])
+        assert len(findings) == 1
+        assert "not.in.catalog" in findings[0].message
+
+    def test_m003_bad_flags_kernel_without_slow(self, tmp_path):
+        # M003 scopes to test files: alias the fixture into one
+        target = tmp_path / "test_m003_fixture.py"
+        shutil.copy(FIXTURES / "M003_bad.py", target)
+        report = engine.lint_paths([target], rules=["M003"])
+        names = "\n".join(f.message for f in report.findings)
+        assert len(report.findings) == 2
+        assert "test_compiles_kernel_only" in names
+        assert "test_inherits_kernel_only" in names  # class-level mark
+        assert "test_compiles_both_marks" not in names
+
+    def test_s001_reasonless_suppression_is_a_finding(self):
+        report = engine.lint_paths([FIXTURES / "S001_bad.py"])
+        s001 = [f for f in report.findings if f.rule == "S001"]
+        assert len(s001) == 1
+        # the reasoned suppression hid its L002; the reasonless one did NOT
+        l002 = [f for f in report.findings if f.rule == "L002"]
+        assert len(l002) == 1
+        assert len(report.suppressed) == 1
+
+
+class TestSuppressions:
+    def test_suppression_on_line_above_applies(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import time\n"
+            "from tendermint_tpu.utils.lockrank import ranked_lock\n"
+            "_lock = ranked_lock('dispatch.state')\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        # tmlint: disable=L002 -- test: line-above placement\n"
+            "        time.sleep(0.1)\n"
+        )
+        report = engine.lint_paths([mod], rules=["L002", "S001"])
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_suppression_only_hides_named_rule(self, tmp_path):
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import time\n"
+            "from tendermint_tpu.utils.lockrank import ranked_lock\n"
+            "_lock = ranked_lock('dispatch.state')\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(0.1)  # tmlint: disable=T001 -- test: wrong rule named\n"
+        )
+        report = engine.lint_paths([mod], rules=["L002", "S001"])
+        assert [f.rule for f in report.findings] == ["L002"]
+
+
+class TestBaseline:
+    def _bad_module(self, tmp_path) -> pathlib.Path:
+        mod = tmp_path / "mod.py"
+        mod.write_text(
+            "import time\n"
+            "from tendermint_tpu.utils.lockrank import ranked_lock\n"
+            "_lock = ranked_lock('dispatch.state')\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(0.1)\n"
+        )
+        return mod
+
+    def test_baseline_grandfathers_then_goes_stale(self, tmp_path):
+        mod = self._bad_module(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        report = engine.lint_paths([mod], rules=["L002"])
+        assert len(report.findings) == 1
+        engine.write_baseline(baseline, report.findings)
+
+        # same finding now baselined, not fresh
+        report2 = engine.lint_paths([mod], rules=["L002"], baseline_path=baseline)
+        assert report2.findings == []
+        assert len(report2.baselined) == 1
+        assert report2.stale_baseline == []
+
+        # fix the code: the entry is reported stale (prune signal)
+        mod.write_text("def f():\n    return 1\n")
+        report3 = engine.lint_paths([mod], rules=["L002"], baseline_path=baseline)
+        assert report3.findings == []
+        assert len(report3.stale_baseline) == 1
+
+    def test_baseline_survives_line_drift(self, tmp_path):
+        mod = self._bad_module(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        engine.write_baseline(
+            baseline, engine.lint_paths([mod], rules=["L002"]).findings
+        )
+        # shift every line down: fingerprints key on source text, not line
+        mod.write_text("# a new leading comment\n" + mod.read_text())
+        report = engine.lint_paths([mod], rules=["L002"], baseline_path=baseline)
+        assert report.findings == []
+        assert len(report.baselined) == 1
+
+    def test_new_finding_not_masked_by_baseline(self, tmp_path):
+        mod = self._bad_module(tmp_path)
+        baseline = tmp_path / "baseline.json"
+        engine.write_baseline(
+            baseline, engine.lint_paths([mod], rules=["L002"]).findings
+        )
+        mod.write_text(
+            mod.read_text()
+            + "def g(q):\n    with _lock:\n        return q.get()\n"
+        )
+        report = engine.lint_paths([mod], rules=["L002"], baseline_path=baseline)
+        assert len(report.findings) == 1  # the NEW .get() only
+        assert len(report.baselined) == 1
+
+    def test_repo_baseline_file_is_valid_and_empty(self):
+        data = json.loads(
+            (REPO / "tools" / "tmlint_baseline.json").read_text()
+        )
+        assert data["version"] == 1
+        assert data["findings"] == {}
+
+
+def run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tools.tmlint", *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+class TestCLI:
+    def test_merged_tree_is_clean_exit_0(self):
+        proc = run_cli("tendermint_tpu")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_bad_fixture_exits_1(self):
+        proc = run_cli(
+            str(FIXTURES / "L001_bad.py"), "--rules", "L001", "--no-baseline"
+        )
+        assert proc.returncode == 1
+        assert "L001" in proc.stdout
+
+    def test_unknown_rule_exits_2(self):
+        proc = run_cli("--rules", "Z999", "tendermint_tpu/analysis")
+        assert proc.returncode == 2
+
+    def test_missing_path_exits_2(self):
+        proc = run_cli("no/such/dir")
+        assert proc.returncode == 2
+
+    def test_list_rules(self):
+        proc = run_cli("--list-rules")
+        assert proc.returncode == 0
+        for code in ("L001", "L002", "T001", "W001", "J001", "M001", "M002",
+                     "M003", "S001"):
+            assert code in proc.stdout
+
+    def test_changed_mode_lints_a_dirty_file(self, tmp_path):
+        # a scratch clone would be heavy; instead verify the plumbing:
+        # an untracked bad file inside the repo is picked up, then removed
+        scratch = REPO / "tools" / "_tmlint_changed_scratch.py"
+        scratch.write_text(
+            "import time\n"
+            "from tendermint_tpu.utils.lockrank import ranked_lock\n"
+            "_lock = ranked_lock('dispatch.state')\n"
+            "def f():\n"
+            "    with _lock:\n"
+            "        time.sleep(0.1)\n"
+        )
+        try:
+            proc = run_cli("--changed", "--no-baseline", "--rules", "L002")
+            assert "_tmlint_changed_scratch.py" in proc.stdout
+            assert proc.returncode == 1
+        finally:
+            scratch.unlink()
+
+
+class TestConftestShims:
+    """The re-homed lints keep their conftest API (tests/test_marker_lint.py
+    exercises the original signatures; this pins the delegation)."""
+
+    def test_metric_shim_delegates(self, tmp_path):
+        from tests.conftest import lint_metric_catalog
+
+        (tmp_path / "mod.py").write_text('N = "tendermint_shim_check_total"\n')
+        off = lint_metric_catalog(roots=[tmp_path])
+        assert len(off) == 1 and off[0].endswith("tendermint_shim_check_total")
+
+    def test_collection_gate_reports_tmlint_findings(self, monkeypatch):
+        import tests.conftest as conftest
+
+        monkeypatch.setattr(
+            conftest, "run_tmlint_gate", lambda: "mod.py:1: L001 boom"
+        )
+        with pytest.raises(pytest.UsageError, match="tmlint"):
+            conftest.pytest_collection_modifyitems(None, [])
+
+    def test_repo_gate_is_currently_clean(self):
+        from tests.conftest import run_tmlint_gate
+
+        assert run_tmlint_gate() is None

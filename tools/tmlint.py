@@ -1,0 +1,143 @@
+"""tmlint CLI — repo-invariant static analysis for tendermint_tpu.
+
+Usage:
+    python -m tools.tmlint [paths...]            # default: tendermint_tpu/
+    python -m tools.tmlint --changed             # only files differing from HEAD
+    python -m tools.tmlint --rules L001,L002 p2p/
+    python -m tools.tmlint --write-baseline      # grandfather current findings
+    python -m tools.tmlint --list-rules
+
+Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+
+Suppress a single finding in source with a REASONED comment on (or one
+line above) the flagged line:
+
+    with self._counter_lock:  # tmlint: disable=L001 -- snapshot only, never nested further
+
+Reasonless suppressions are themselves findings (S001). See
+docs/STATIC_ANALYSIS.md for the rule catalog and the lock-rank table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import subprocess
+import sys
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(_REPO))
+
+from tendermint_tpu.analysis import engine  # noqa: E402
+
+
+def changed_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """Python files differing from HEAD (staged, unstaged, untracked) —
+    the fast pre-commit lane."""
+    out: list[pathlib.Path] = []
+    for args in (
+        ["git", "diff", "--name-only", "HEAD", "--", "*.py"],
+        ["git", "ls-files", "--others", "--exclude-standard", "--", "*.py"],
+    ):
+        try:
+            proc = subprocess.run(
+                args, cwd=root, capture_output=True, text=True, check=True
+            )
+        except (OSError, subprocess.CalledProcessError) as e:
+            raise SystemExit(f"tmlint --changed: git failed: {e}")
+        for line in proc.stdout.splitlines():
+            p = root / line.strip()
+            if p.suffix == ".py" and p.exists() and not engine._is_fixture(p):
+                out.append(p)
+    return sorted(set(out))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tmlint", description="repo-invariant static analyzer"
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: tendermint_tpu/)",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=str(_REPO / engine.DEFAULT_BASELINE),
+        help="findings baseline file (default: tools/tmlint_baseline.json)",
+    )
+    ap.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="ignore the baseline (report everything)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files differing from HEAD (fast pre-commit mode)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="also show baselined and suppressed findings",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, rule in sorted(engine.all_rules().items()):
+            print(f"{code}  {rule.description}")
+        print("S001  suppression comment without a reason string")
+        return 0
+
+    if args.changed:
+        paths = changed_files(_REPO)
+        if not paths:
+            print("tmlint: no changed python files")
+            return 0
+    elif args.paths:
+        paths = [pathlib.Path(p) for p in args.paths]
+        for p in paths:
+            if not p.exists():
+                print(f"tmlint: no such path: {p}", file=sys.stderr)
+                return 2
+    else:
+        paths = [_REPO / "tendermint_tpu"]
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+
+    baseline = None if args.no_baseline else args.baseline
+    try:
+        report = engine.lint_paths(
+            paths, rules=rules, baseline_path=baseline, root=_REPO
+        )
+    except ValueError as e:
+        print(f"tmlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        engine.write_baseline(args.baseline, report.findings)
+        print(
+            f"tmlint: baselined {len(report.findings)} finding(s) "
+            f"-> {args.baseline}"
+        )
+        return 0
+
+    print(engine.render_report(report, verbose=args.verbose))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
