@@ -59,6 +59,7 @@ from tendermint_tpu.types.tx import Txs
 from tendermint_tpu.types.vote import VOTE_TYPE_PRECOMMIT, VOTE_TYPE_PREVOTE, Vote
 from tendermint_tpu.types.vote_set import VoteSet
 from tendermint_tpu.telemetry import TRACER
+from tendermint_tpu.telemetry import heightlog as _heightlog
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
@@ -94,6 +95,7 @@ class ConsensusState:
         tx_indexer=None,
         hasher=None,
         evidence_pool=None,
+        heightlog=None,
     ) -> None:
         self.config = config
         self.app_conn = app_conn
@@ -169,6 +171,20 @@ class ConsensusState:
         self._phase_name: str | None = None
         self._phase_started = time_mod.monotonic()
         self._height_started = time_mod.monotonic()
+        # finality observatory: one ledger record per committed height
+        # (phase durations, wait-vs-work split, critical-path label,
+        # laggard validator) — an injected ledger persists under the
+        # node's data dir; the default is an in-memory ring.
+        self.height_ledger = (
+            heightlog if heightlog is not None else _heightlog.HeightLedger()
+        )
+        self.vote_arrivals = _heightlog.VoteArrivalRollup()
+        self._last_commit_wall: float | None = None
+        self._phase_acc: dict[str, list] = {}  # phase -> [dur_s, work_s]
+        self._height_work0 = _heightlog.work_totals()
+        self._phase_work0 = self._height_work0
+        self._val_arrivals: dict[int, tuple[str, float]] = {}
+        self._apply_s = 0.0
 
         self._update_to_state(state)
         if hasattr(self.mempool, "set_on_txs_available"):
@@ -468,6 +484,7 @@ class ConsensusState:
             )
         with self._mtx:
             for rec, ok in zip(records, verdicts):
+                self._observe_vote_arrival(rec)
                 try:
                     with _trace.use(rec.ctx):
                         self._handle_vote(
@@ -501,6 +518,29 @@ class ConsensusState:
             round=v.round,
             type=v.type,
         )
+
+    def _observe_vote_arrival(self, rec) -> None:
+        """Per-peer vote-arrival latency (vote timestamp → local
+        arrival) for the rollup + the current height's laggard-validator
+        attribution. Replayed WAL records (arrived == 0) are skipped;
+        delays are clamped — a byzantine validator controls its own
+        timestamps and must not poison the attribution."""
+        v = rec.msg
+        if not rec.arrived or not isinstance(v, Vote) or v.height != self.height:
+            return
+        delay = rec.arrived - v.timestamp / 1e9
+        if delay < 0.0:
+            delay = 0.0  # clock skew / future-stamped vote
+        elif delay > _heightlog.MAX_ARRIVAL_S:
+            delay = _heightlog.MAX_ARRIVAL_S
+        self.vote_arrivals.observe(rec.peer_id or "self", delay)
+        _metrics.VOTE_ARRIVAL_SECONDS.observe(delay)
+        cur = self._val_arrivals.get(v.validator_index)
+        if cur is None or delay > cur[1]:
+            self._val_arrivals[v.validator_index] = (
+                v.validator_address.hex()[:12],
+                delay,
+            )
 
     def _vote_queue(self):
         if self._vote_dispatch is None:
@@ -568,6 +608,7 @@ class ConsensusState:
             # outbound frames without any reactor plumbing.
             with _trace.use(getattr(item, "ctx", None)):
                 if isinstance(m, Vote):
+                    self._observe_vote_arrival(item)
                     self._handle_vote(m, item.peer_id)
                     if item.ctx is not None:
                         self._observe_vote_e2e(item, time_mod.time())
@@ -595,8 +636,15 @@ class ConsensusState:
     def _observe_phase(self, next_name: str | None) -> None:
         """Close the open round-phase span (histogram + tracer) and open
         `next_name`. Called on every phase transition under the state
-        lock; None closes without opening (height finalized)."""
+        lock; None closes without opening (height finalized).
+
+        Ledger bookkeeping rides the same transitions: per-height phase
+        durations accumulate (a phase can repeat across rounds) with a
+        wait-vs-work split stitched from the exported verify/hash
+        stopwatches, and the gap from height start to the first opened
+        phase is the NewHeight wait."""
         now = time_mod.monotonic()
+        work = _heightlog.work_totals()
         if self._phase_name is not None:
             dur = now - self._phase_started
             _metrics.CONSENSUS_PHASE_SECONDS.labels(
@@ -610,8 +658,23 @@ class ConsensusState:
                 height=self.height,
                 round=self.round,
             )
+            work_s = max(
+                0.0,
+                (work["verify"] + work["hash"])
+                - (self._phase_work0["verify"] + self._phase_work0["hash"]),
+            )
+            acc = self._phase_acc.setdefault(self._phase_name, [0.0, 0.0])
+            acc[0] += dur
+            acc[1] += min(work_s, dur)
+        elif next_name is not None:
+            # first phase of the height opening: everything since the
+            # height started (commit timeout + waiting for round 0)
+            self._phase_acc.setdefault("new_height", [0.0, 0.0])[0] += max(
+                0.0, now - self._height_started
+            )
         self._phase_name = next_name
         self._phase_started = now
+        self._phase_work0 = work
 
     # ------------------------------------------------------ state plumbing
 
@@ -655,6 +718,13 @@ class ConsensusState:
         self.last_commit = last_commit
         self._phase_name = None
         self._height_started = time_mod.monotonic()
+        # fresh per-height ledger accumulators (phase durations,
+        # work-stopwatch baseline, per-validator vote arrivals)
+        self._phase_acc = {}
+        self._height_work0 = _heightlog.work_totals()
+        self._phase_work0 = self._height_work0
+        self._val_arrivals = {}
+        self._apply_s = 0.0
         # the height's block trace context: adopted from the proposal
         # (proposer: its first traced tx; receivers: the proposal
         # frame's context) — vote-batch verifies for this height are
@@ -1230,6 +1300,7 @@ class ConsensusState:
             fail_point()  # ENDHEIGHT written, before ApplyBlock
             state_copy = self.state.copy()
             tx_results: list[tuple[bytes, object]] = []
+            t_apply = time_mod.monotonic()
             apply_block(
                 state_copy,
                 block,
@@ -1241,6 +1312,7 @@ class ConsensusState:
                 on_tx_result=lambda i, tx, res: tx_results.append((tx, res)),
                 hasher=self.hasher,
             )
+            self._apply_s = time_mod.monotonic() - t_apply
 
             fail_point()  # applied, before round-state reset
             if self.evidence_pool is not None:
@@ -1267,6 +1339,7 @@ class ConsensusState:
                 txs=len(block.data.txs),
                 hash=block.hash().hex()[:12],
             )
+            self._record_height_ledger(height, block, wall_end, height_wall)
             # close every committed traced tx: first-seen -> committed
             # on THIS node's clock, linked back by exemplar trace id
             take_trace = getattr(self.mempool, "take_trace", None)
@@ -1319,6 +1392,95 @@ class ConsensusState:
             self.event_switch.fire(ev.EVENT_TX, data)
             self.event_switch.fire(ev.event_tx(tx_hash(tx)), data)
         self._schedule_round0()
+
+    def _record_height_ledger(
+        self, height: int, block: Block, wall_end: float, height_wall: float
+    ) -> None:
+        """Assemble the height's ledger record at finalize: phase
+        durations with their wait-vs-work split, the commit-to-commit
+        gap, critical-path attribution over the candidate contributors,
+        and the laggard validator from the vote-arrival tracking.
+        Observability must never fail the commit — errors are printed,
+        not raised."""
+        try:
+            work1 = _heightlog.work_totals()
+            w0 = self._height_work0
+            verify_s = max(0.0, work1["verify"] - w0["verify"])
+            hash_s = max(0.0, work1["hash"] - w0["hash"])
+            coalescer_s = max(0.0, work1["coalescer"] - w0["coalescer"])
+            dispatch_s = max(0.0, work1["dispatch"] - w0["dispatch"])
+            apply_s = self._apply_s
+            phases: dict[str, dict] = {}
+            for name in ("new_height", "propose", "prevote", "precommit", "commit"):
+                dur, work = self._phase_acc.get(name, (0.0, 0.0))
+                if name == "commit":
+                    # the commit phase closes AFTER apply; split the
+                    # apply stopwatch out so it reads as its own phase
+                    dur = max(0.0, dur - apply_s)
+                work = min(work, dur)
+                phases[name] = {
+                    "s": round(dur, 6),
+                    "work_s": round(work, 6),
+                    "wait_s": round(max(0.0, dur - work), 6),
+                }
+            phases["apply"] = {
+                "s": round(apply_s, 6),
+                "work_s": round(apply_s, 6),
+                "wait_s": 0.0,
+            }
+            for name, p in phases.items():
+                _metrics.HEIGHT_PHASE_SECONDS.labels(phase=name).observe(p["s"])
+            finality_s = None
+            if self._last_commit_wall is not None:
+                finality_s = max(0.0, wall_end - self._last_commit_wall)
+                _metrics.FINALITY_SECONDS.observe(finality_s)
+            self._last_commit_wall = wall_end
+            # critical-path candidates: wall-clock phase groups plus the
+            # registry-stitched device/coalescer stopwatch deltas (the
+            # latter are process-global — cross-node sums in multi-node
+            # harnesses; the wall-clock groups are per-node exact)
+            contributors = {
+                "proposal_wait": phases["new_height"]["s"] + phases["propose"]["s"],
+                "vote_gather": phases["prevote"]["s"] + phases["precommit"]["s"],
+                "commit_wait": phases["commit"]["s"],
+                "coalescer_wait": coalescer_s,
+                "dispatch_launch": verify_s + dispatch_s,
+                "abci_apply": apply_s,
+                "merkle_hash": hash_s,
+            }
+            critical = max(contributors, key=lambda k: contributors[k])
+            laggard = None
+            if self._val_arrivals:
+                idx, (addr, delay) = max(
+                    self._val_arrivals.items(), key=lambda kv: kv[1][1]
+                )
+                laggard = {
+                    "validator": addr,
+                    "index": idx,
+                    "delay_s": round(delay, 6),
+                }
+                _metrics.VOTE_ARRIVAL_MAX.set(delay)
+            self.height_ledger.record(
+                {
+                    "height": height,
+                    "round": self.commit_round,
+                    "txs": len(block.data.txs),
+                    "t_start": round(wall_end - height_wall, 6),
+                    "t_commit": round(wall_end, 6),
+                    "height_s": round(height_wall, 6),
+                    "finality_s": round(finality_s, 6)
+                    if finality_s is not None
+                    else None,
+                    "phases": phases,
+                    "path": {k: round(v, 6) for k, v in contributors.items()},
+                    "critical_path": critical,
+                    "laggard": laggard,
+                }
+            )
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
 
     # ---------------------------------------------------------------- votes
 

@@ -107,6 +107,15 @@ class Node:
         FLIGHT.set_node_id(self.node_id)
         FLIGHT.set_dump_dir(os.path.join(cfg.home, cfg.base.db_dir))
         install_signal_dump()
+        # finality observatory: one persisted record per committed
+        # height (phases, critical path, laggard) under the data dir —
+        # `/health`'s SLO window and tools/finality_report.py read it
+        from tendermint_tpu.telemetry.heightlog import HeightLedger
+
+        self.height_ledger = HeightLedger(
+            path=os.path.join(cfg.home, cfg.base.db_dir, "heights.jsonl"),
+            node_id=self.node_id,
+        )
 
         # state + stores
         self.state_db = _db("state")
@@ -207,6 +216,7 @@ class Node:
             tx_indexer=self.tx_indexer,
             hasher=hasher,
             evidence_pool=self.evidence_pool,
+            heightlog=self.height_ledger,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
         self.consensus_reactor = ConsensusReactor(self.consensus, fast_sync=fast_sync)
@@ -568,6 +578,15 @@ class Node:
 
             TRACER.clear_sink(self._span_log.append)
             self._span_log.close()
+        if getattr(self, "height_ledger", None) is not None:
+            self.height_ledger.close()
+
+    def health(self) -> dict:
+        """The `/health` snapshot (telemetry/health.py): readiness +
+        degradation checks + the rolling finality SLO, all node-local."""
+        from tendermint_tpu.telemetry.health import build_health
+
+        return build_health(self)
 
     # -- convenience -------------------------------------------------------
 

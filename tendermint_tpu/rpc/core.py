@@ -231,8 +231,21 @@ def make_routes(node) -> dict:
             "peers": peers,
         }
 
+    def health() -> dict:
+        """Health / readiness snapshot (telemetry/health.py): status ∈
+        ok | degraded | not_ready, per-check detail, rolling finality
+        SLO. Also served as plain `GET /health` with HTTP 503 on
+        not_ready so load balancers can act without parsing JSON-RPC."""
+        from tendermint_tpu.telemetry.health import build_health
+
+        return build_health(node)
+
     def dump_telemetry(
-        spans: int = 128, prefix: str = "", trace_id: str = "", flight: int = 0
+        spans: int = 128,
+        prefix: str = "",
+        trace_id: str = "",
+        flight: int = 0,
+        heights: int = 0,
     ) -> dict:
         """Structured telemetry dump: the full metrics registry, the
         recent span window (consensus round phases, device dispatch),
@@ -242,7 +255,8 @@ def make_routes(node) -> dict:
         `trace_id` (hex) narrows the span window to one distributed
         trace — the live-node half of `tools/trace_timeline.py`;
         `flight` > 0 additionally returns that many recent flight-
-        recorder events."""
+        recorder events; `heights` > 0 returns the last N HeightLedger
+        records (per-height phases + critical-path attribution)."""
         from tendermint_tpu.telemetry import REGISTRY, TRACER
 
         breakers = {}
@@ -282,10 +296,20 @@ def make_routes(node) -> dict:
                 ),
             },
         }
+        # per-peer vote-arrival rollup (the laggard signal
+        # tools/finality_report.py consumes) — peer-id cardinality, so
+        # dump-only like the send-queue depths above
+        arrivals = getattr(node.consensus, "vote_arrivals", None)
+        if arrivals is not None:
+            out["vote_arrivals"] = arrivals.snapshot()
         if int(flight) > 0:
             from tendermint_tpu.telemetry.flightrec import FLIGHT
 
             out["flight"] = FLIGHT.recent(n=int(flight))
+        if int(heights) > 0:
+            ledger = getattr(node.consensus, "height_ledger", None)
+            if ledger is not None:
+                out["heights"] = ledger.recent(int(heights))
         return out
 
     def abci_query(path: str = "", data: str = "", height: int = 0, prove: bool = False) -> dict:
@@ -555,6 +579,7 @@ def make_routes(node) -> dict:
         "validators": validators,
         "dump_consensus_state": dump_consensus_state,
         "dump_telemetry": dump_telemetry,
+        "health": health,
         "abci_query": abci_query,
         "abci_info": abci_info,
         "num_unconfirmed_txs": num_unconfirmed_txs,

@@ -194,6 +194,12 @@ def _make_handler(routes: dict, event_switch=None):
                 # so any scraper can point straight at the RPC listener
                 self._serve_metrics()
                 return
+            if method == "health" and "health" in routes:
+                # plain-HTTP readiness probe: the health dict as the raw
+                # body, 503 when not ready — load balancers act on the
+                # status code, dashboards read the JSON
+                self._serve_health()
+                return
             if method == "":
                 # route listing (reference serves an index page)
                 self._respond({"jsonrpc": "2.0", "id": -1, "result": sorted(routes)})
@@ -220,6 +226,19 @@ def _make_handler(routes: dict, event_switch=None):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _serve_health(self):
+            from tendermint_tpu.telemetry import metrics as _metrics
+
+            try:
+                body = routes["health"]()
+            except Exception as e:
+                _metrics.RPC_REQUESTS.labels(method="health", result="error").inc()
+                self._respond({"status": "error", "error": str(e)}, status=500)
+                return
+            _metrics.RPC_REQUESTS.labels(method="health", result="ok").inc()
+            status = 200 if body.get("ready", False) else 503
+            self._respond(body, status=status)
 
         def _upgrade_websocket(self):
             from tendermint_tpu.rpc.websocket import WSSession, accept_key

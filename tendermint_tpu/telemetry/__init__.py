@@ -28,6 +28,7 @@ __all__ = [
     "FLIGHT",
     "FlightRecorder",
     "Gauge",
+    "HeightLedger",
     "Histogram",
     "Registry",
     "REGISTRY",
@@ -57,4 +58,8 @@ def __getattr__(name: str):
         from tendermint_tpu.telemetry import tracectx
 
         return tracectx.TraceContext
+    if name == "HeightLedger":
+        from tendermint_tpu.telemetry import heightlog
+
+        return heightlog.HeightLedger
     raise AttributeError(name)

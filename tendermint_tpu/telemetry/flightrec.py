@@ -92,6 +92,16 @@ class FlightRecorder:
             return None
         try:
             os.makedirs(target, exist_ok=True)
+            # the last K height-ledger records ride every dump: the
+            # post-mortem's "which heights led into this, and where did
+            # their time go" (telemetry/heightlog.py; lazy import — the
+            # ledger imports the metric catalog, not this module)
+            try:
+                from tendermint_tpu.telemetry import heightlog
+
+                heights = heightlog.recent_records(32)
+            except Exception:
+                heights = []
             with self._lock:
                 events = list(self._events)
                 self._dump_seq += 1
@@ -108,6 +118,7 @@ class FlightRecorder:
                         "reason": reason,
                         "dumped_at": time.time(),
                         "events": events,
+                        "heights": heights,
                     },
                     f,
                     separators=(",", ":"),

@@ -1,0 +1,192 @@
+"""Health / readiness snapshot + rolling finality SLO.
+
+The machine-readable signal ROADMAP item 1's read-replica fleet sits
+behind: one structured dict (served as `GET /health` on the RPC
+listener and as the `health` JSON-RPC method) that folds the telemetry
+the node already keeps into three states:
+
+* **ok** — serving, all checks green;
+* **degraded** — serving, but something an operator should look at is
+  wrong: a circuit breaker is off `closed` (device crypto degraded to
+  host), the verify mesh is running on survivors, the peer count is
+  below the floor, or commits have stalled past the lag ceiling;
+* **not_ready** — do not route traffic here: the node is still
+  fast-syncing / state-syncing, or its consensus loop halted on a
+  fatal error. `GET /health` maps this to HTTP 503 so any off-the-shelf
+  load balancer can act on it without parsing the body.
+
+Everything is derived from NODE-LOCAL objects (the node's own breaker
+snapshots, its switch's peer count, its HeightLedger) — never from the
+process-global registry, so the multi-node-in-process harnesses get
+per-node answers.
+
+The **finality SLO** section evaluates the rolling window of
+commit-to-commit gaps from the height ledger against a p99 target and
+reports error-budget burn (breaches / allowed breaches). It is
+deliberately *reported, not folded into the status*: an SLO breach is
+an alerting decision, and a load balancer yanking a replica because the
+whole chain was slow would make the incident worse, not better.
+
+Knobs (env):
+  TENDERMINT_TPU_FINALITY_SLO_P99_S  p99 finality target, seconds (1.0)
+  TENDERMINT_TPU_SLO_WINDOW          heights in the rolling window (64)
+  TENDERMINT_TPU_SLO_BUDGET          allowed breach fraction (0.01)
+  TENDERMINT_TPU_HEALTH_MIN_PEERS    peer floor before degraded (1)
+  TENDERMINT_TPU_HEALTH_MAX_LAG_S    commit-age ceiling, seconds (60)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _pctl(sorted_vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over raw samples (empirical, not bucket
+    interpolation — the window is small and exact)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, max(0, math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[idx]
+
+
+def _breaker_check(node) -> dict:
+    """Every breaker snapshot reachable from this node's verify/hash
+    services (the same objects `dump_telemetry` serves): ok iff all
+    report state == closed."""
+    states: dict[str, str] = {}
+    for name, svc in (
+        ("verifier", getattr(getattr(node, "consensus", None), "verifier", None)),
+        ("hasher", getattr(node, "hasher", None)),
+    ):
+        if svc is None or not hasattr(svc, "snapshot"):
+            continue
+        try:
+            snap = svc.snapshot()
+        except Exception:
+            continue
+        state = snap.get("state")
+        if state is not None:
+            states[name] = str(state)
+    return {"ok": all(s == "closed" for s in states.values()), "states": states}
+
+
+def _mesh_check(node) -> dict:
+    """Sharded-mesh degradation from the verifier snapshot: active <
+    total means the mesh is running on survivors (re-mesh absorbed a
+    chip loss below the breaker). Nodes without a mesh are trivially
+    ok."""
+    svc = getattr(getattr(node, "consensus", None), "verifier", None)
+    snap = {}
+    if svc is not None and hasattr(svc, "snapshot"):
+        try:
+            snap = svc.snapshot() or {}
+        except Exception:
+            snap = {}
+    mesh = snap.get("mesh")
+    if not isinstance(mesh, dict):
+        return {"ok": True, "present": False}
+    active = int(mesh.get("devices_active", 0))
+    total = int(mesh.get("devices_total", 0))
+    return {
+        "ok": active >= total,
+        "present": True,
+        "devices_active": active,
+        "devices_total": total,
+    }
+
+
+def build_health(node, ledger=None) -> dict:
+    """The health snapshot for one composed node (`node.Node` or
+    anything duck-typed close enough — every read is getattr-tolerant,
+    so harness stubs work)."""
+    target = _env_float("TENDERMINT_TPU_FINALITY_SLO_P99_S", 1.0)
+    window_n = int(_env_float("TENDERMINT_TPU_SLO_WINDOW", 64))
+    budget_frac = _env_float("TENDERMINT_TPU_SLO_BUDGET", 0.01)
+    min_peers = int(_env_float("TENDERMINT_TPU_HEALTH_MIN_PEERS", 1))
+    max_lag = _env_float("TENDERMINT_TPU_HEALTH_MAX_LAG_S", 60.0)
+
+    consensus = getattr(node, "consensus", None)
+    if ledger is None:
+        ledger = getattr(node, "height_ledger", None)
+    if ledger is None:
+        ledger = getattr(consensus, "height_ledger", None)
+
+    # -- readiness ---------------------------------------------------------
+    bc = getattr(node, "blockchain_reactor", None)
+    catching_up = bool(getattr(bc, "fast_sync", False))
+    ss = getattr(node, "statesync_reactor", None)
+    state_syncing = bool(getattr(ss, "sync", False)) and (
+        getattr(ss, "restored_state", None) is None
+    )
+    fatal = getattr(consensus, "fatal_error", None)
+    checks: dict[str, dict] = {
+        "consensus": {
+            "ok": fatal is None,
+            "fatal": type(fatal).__name__ if fatal is not None else None,
+        },
+        "sync": {
+            "ok": not (catching_up or state_syncing),
+            "fast_sync": catching_up,
+            "state_sync": state_syncing,
+        },
+    }
+
+    # -- degradation -------------------------------------------------------
+    checks["breakers"] = _breaker_check(node)
+    checks["mesh"] = _mesh_check(node)
+    switch = getattr(node, "switch", None)
+    n_peers = switch.n_peers() if switch is not None else 0
+    checks["peers"] = {"ok": n_peers >= min_peers, "count": n_peers, "min": min_peers}
+
+    last = ledger.last() if ledger is not None else None
+    lag_s = None
+    if last is not None and isinstance(last.get("t_commit"), (int, float)):
+        lag_s = max(0.0, time.time() - last["t_commit"])
+    checks["commit_lag"] = {
+        # no records yet = not enough data to call it stalled (a node
+        # that is genuinely behind shows up in the sync check instead)
+        "ok": lag_s is None or catching_up or lag_s <= max_lag,
+        "lag_s": round(lag_s, 3) if lag_s is not None else None,
+        "max_s": max_lag,
+    }
+
+    # -- finality SLO (reported, never folded into status) -----------------
+    gaps = sorted(ledger.finality_window(window_n)) if ledger is not None else []
+    breaches = sum(1 for g in gaps if g > target)
+    budget = max(1.0, budget_frac * len(gaps)) if gaps else 1.0
+    burn = breaches / budget
+    slo = {
+        "target_p99_s": target,
+        "window": len(gaps),
+        "p50_s": round(_pctl(gaps, 0.5), 6) if gaps else None,
+        "p99_s": round(_pctl(gaps, 0.99), 6) if gaps else None,
+        "breaches": breaches,
+        "error_budget": round(budget, 3),
+        "budget_burn": round(burn, 3),
+        "ok": burn <= 1.0,
+    }
+
+    not_ready = not (checks["consensus"]["ok"] and checks["sync"]["ok"])
+    degraded = not all(
+        checks[k]["ok"] for k in ("breakers", "mesh", "peers", "commit_lag")
+    )
+    status = "not_ready" if not_ready else ("degraded" if degraded else "ok")
+    store = getattr(node, "block_store", None)
+    return {
+        "status": status,
+        "ready": not not_ready,
+        "node_id": getattr(node, "node_id", ""),
+        "height": getattr(store, "height", 0) if store is not None else 0,
+        "catching_up": catching_up or state_syncing,
+        "checks": checks,
+        "finality_slo": slo,
+    }

@@ -61,6 +61,39 @@ VOTE_DRAIN_BATCH = Histogram(
     buckets=SIZE_BUCKETS,
 )
 
+# -- finality observatory (telemetry/heightlog.py, consensus/state.py) --------
+#
+# `phase` is the fixed height lifecycle: new_height (commit timeout +
+# waiting for round 0), propose, prevote, precommit, commit (waiting
+# for the committed block, pre-apply), apply (ABCI + state update) —
+# summed across rounds of one height, so the per-height phase set
+# always sums to ~the commit-to-commit gap.
+
+FINALITY_SECONDS = Histogram(
+    "tendermint_finality_seconds",
+    "Commit-to-commit gap: wall time between consecutive finalized "
+    "commits on this node (the user-facing finality latency)",
+    buckets=LATENCY_BUCKETS,
+)
+HEIGHT_PHASE_SECONDS = Histogram(
+    "tendermint_height_phase_seconds",
+    "Per-height time in each lifecycle phase (summed across rounds), "
+    "from the HeightLedger record assembled at finalize",
+    labelnames=("phase",),
+    buckets=LATENCY_BUCKETS,
+)
+VOTE_ARRIVAL_SECONDS = Histogram(
+    "tendermint_consensus_vote_arrival_seconds",
+    "Vote timestamp to local arrival, aggregated over all peers "
+    "(per-peer rollup lives in dump_telemetry; clock-skew clamped)",
+    buckets=LATENCY_BUCKETS,
+)
+VOTE_ARRIVAL_MAX = Gauge(
+    "tendermint_consensus_vote_arrival_max_seconds",
+    "Worst single vote-arrival delay observed in the last finalized "
+    "height (the laggard-validator signal)",
+)
+
 # -- device dispatch (verify / hash hot paths) --------------------------------
 
 VERIFY_BATCH_SIZE = Histogram(
@@ -275,6 +308,8 @@ for _direction in ("shrink", "restore"):
     MESH_REMESH.labels(direction=_direction).inc(0)
 for _stage in ("drain", "verify", "e2e"):
     VOTE_STAGE.labels(stage=_stage)
+for _phase in ("new_height", "propose", "prevote", "precommit", "commit", "apply"):
+    HEIGHT_PHASE_SECONDS.labels(phase=_phase)
 
 # -- state sync ---------------------------------------------------------------
 

@@ -120,6 +120,20 @@ class _Metric:
         with self._lock:
             return [(k, v.snapshot()) for k, v in self._children.items()]
 
+    def sum_total(self) -> float:
+        """Sum of all children's observation sums (histograms) or
+        values (counters/gauges) — the cheap read hot-path stopwatch
+        consumers (telemetry/heightlog.py) take at phase boundaries,
+        without building per-child bucket snapshots."""
+        total = 0.0
+        with self._lock:
+            for c in self._children.values():
+                s = getattr(c, "_sum", None)
+                if s is None:
+                    s = getattr(c, "_value", 0.0)
+                total += s
+        return float(total)
+
     # unlabeled convenience: family proxies to its default child
     def _child0(self):
         if self._default is None:

@@ -169,9 +169,17 @@ class NemesisNode:
         from tendermint_tpu.evidence import EvidencePool, EvidenceReactor
         from tendermint_tpu.state.state import load_state
 
+        from tendermint_tpu.telemetry.heightlog import HeightLedger
+
         state = load_state(self.state_db)
         self.store = BlockStore(self.store_db)
         self.conns = local_client_creator(self.app)()
+        # finality ledger persists next to the WAL (tail reloads across
+        # crash/restart; tools/finality_report.py merges the nodes')
+        self.height_ledger = HeightLedger(
+            path=os.path.join(os.path.dirname(self.wal_path), "heights.jsonl"),
+            node_id=f"node{self.index}",
+        )
         # evidence WAL survives crash/restart next to the consensus WAL
         self.evidence_pool = EvidencePool(
             wal_path=os.path.join(os.path.dirname(self.wal_path), "evidence.wal"),
@@ -190,6 +198,7 @@ class NemesisNode:
             verifier=self.verifier,
             hasher=self.hasher,
             evidence_pool=self.evidence_pool,
+            heightlog=self.height_ledger,
         )
         self.reactor = ConsensusReactor(self.cs)
         self.switch = Switch(
@@ -210,6 +219,7 @@ class NemesisNode:
         if self.running:
             self.switch.stop()
             self.evidence_pool.close()
+            self.height_ledger.close()
             self.running = False
 
     def crash(self) -> None:
@@ -741,15 +751,21 @@ class Nemesis:
         return [n.store.height for n in self.nodes]
 
     def _violation(self, msg: str) -> InvariantViolation:
-        """Build the violation AND dump the flight recorder: the ring
-        of round transitions / flushes / launches leading up to the
-        break is the forensic record, and the dump path rides the
-        assertion message so a red CI run is self-diagnosing."""
+        """Build the violation AND dump the forensics: the flight
+        recorder's ring of round transitions / flushes / launches, plus
+        the height ledgers' per-height critical-path records. Both dump
+        paths ride the assertion message so a red CI run is
+        self-diagnosing (`tools/trace_timeline.py --flight`,
+        `tools/finality_report.py --ledgers`)."""
+        from tendermint_tpu.telemetry import heightlog
         from tendermint_tpu.telemetry.flightrec import FLIGHT
 
         path = FLIGHT.dump(reason="invariant-violation", dir=self.home)
         if path:
             msg = f"{msg} [flight recorder: {path}]"
+        hpath = heightlog.dump_all(self.home, reason="invariant-violation")
+        if hpath:
+            msg = f"{msg} [height ledger: {hpath}]"
         return InvariantViolation(msg)
 
     def check_no_fork(self) -> None:

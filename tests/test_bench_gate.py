@@ -1,0 +1,132 @@
+"""Bench regression gate (`tools/bench_gate.py`): rule evaluation,
+exit codes, and — slow-marked — the committed BENCH_hotpath.json
+holding every committed floor in tools/bench_floors.json."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.bench_gate import check_rule, main, resolve, run_gate
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BENCH = {
+    "metric": "x",
+    "detail": {
+        "verify": {"host": {"verifies_per_s": 3000.0, "p99_ms": 2.4}},
+        "flags": {"ok": True, "bad": False},
+        "rows": [{"v": 1}, {"v": 2}],
+        "absent_section": None,
+    },
+}
+
+
+class TestResolve:
+    def test_dict_walk(self):
+        assert resolve(BENCH, "detail.verify.host.p99_ms") == (True, 2.4)
+
+    def test_list_index(self):
+        assert resolve(BENCH, "detail.rows.1.v") == (True, 2)
+
+    def test_missing(self):
+        assert resolve(BENCH, "detail.verify.device.p99_ms")[0] is False
+        assert resolve(BENCH, "nope")[0] is False
+        assert resolve(BENCH, "metric.deeper")[0] is False
+
+
+class TestRules:
+    def test_min_max(self):
+        ok, _ = check_rule(
+            BENCH, {"path": "detail.verify.host.verifies_per_s", "min": 1000}
+        )
+        assert ok == "ok"
+        st, msg = check_rule(
+            BENCH, {"path": "detail.verify.host.p99_ms", "max": 1.0}
+        )
+        assert st == "fail" and "ceiling" in msg
+        st, _ = check_rule(
+            BENCH, {"path": "detail.verify.host.verifies_per_s", "min": 5000}
+        )
+        assert st == "fail"
+
+    def test_truthy(self):
+        assert check_rule(BENCH, {"path": "detail.flags.ok", "truthy": True})[0] == "ok"
+        assert (
+            check_rule(BENCH, {"path": "detail.flags.bad", "truthy": True})[0]
+            == "fail"
+        )
+
+    def test_missing_vs_optional(self):
+        rule = {"path": "detail.absent_section.speedup", "min": 1}
+        assert check_rule(BENCH, rule)[0] == "fail"
+        assert check_rule(BENCH, {**rule, "optional": True})[0] == "skip"
+        # null value behaves like missing
+        assert (
+            check_rule(
+                BENCH, {"path": "detail.absent_section", "min": 1, "optional": True}
+            )[0]
+            == "skip"
+        )
+
+    def test_non_numeric_fails(self):
+        assert check_rule(BENCH, {"path": "metric", "min": 1})[0] == "fail"
+
+    def test_run_gate_aggregates(self):
+        ok, lines = run_gate(
+            BENCH,
+            {
+                "floors": [
+                    {"path": "detail.verify.host.p99_ms", "max": 50},
+                    {"path": "detail.verify.host.p99_ms", "max": 1},
+                ]
+            },
+        )
+        assert not ok
+        assert "1 regressed" in lines[-1]
+
+
+class TestCLI:
+    def _write(self, tmp_path, bench, floors):
+        bp = tmp_path / "bench.json"
+        fp = tmp_path / "floors.json"
+        bp.write_text(json.dumps(bench))
+        fp.write_text(json.dumps(floors))
+        return str(bp), str(fp)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        bp, fp = self._write(
+            tmp_path,
+            BENCH,
+            {"floors": [{"path": "detail.verify.host.p99_ms", "max": 50}]},
+        )
+        assert main(["--bench", bp, "--floors", fp]) == 0
+        bp2, fp2 = self._write(
+            tmp_path,
+            BENCH,
+            {"floors": [{"path": "detail.verify.host.p99_ms", "max": 1}]},
+        )
+        assert main(["--bench", bp2, "--floors", fp2]) == 1
+        assert main(["--bench", str(tmp_path / "nope.json"), "--floors", fp]) == 2
+        capsys.readouterr()
+
+
+@pytest.mark.slow
+class TestCommittedFloors:
+    def test_committed_bench_holds_committed_floors(self, capsys):
+        """The CI gate itself: the repo's BENCH_hotpath.json must hold
+        every floor in tools/bench_floors.json — a perf PR reseeding the
+        bench below a floor has to touch the floors file too, visibly."""
+        rc = main(
+            [
+                "--bench",
+                os.path.join(_REPO, "BENCH_hotpath.json"),
+                "--floors",
+                os.path.join(_REPO, "tools", "bench_floors.json"),
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, f"bench gate regressed:\n{out}"

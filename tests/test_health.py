@@ -1,0 +1,299 @@
+"""`/health` endpoint + health snapshot: unit coverage of the status
+derivation, then the nemesis-driven state transitions asserted ON THE
+ENDPOINT (not internals): breaker trip → degraded, mesh shrink →
+degraded, heal/re-probe → ok, fresh fast-syncing joiner → not_ready."""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.services.resilient import ResilientVerifier
+from tendermint_tpu.services.verifier import HostBatchVerifier
+from tendermint_tpu.telemetry.health import build_health
+from tendermint_tpu.telemetry.heightlog import HeightLedger
+from tendermint_tpu.utils import fail
+from tendermint_tpu.utils.circuit import CircuitBreaker
+
+
+def _get_health(port: int):
+    """(http_status, body) for GET /health — 503 must carry the body
+    too (load balancers read the code, operators read the JSON)."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=10
+        ) as resp:
+            return resp.status, json.load(resp)
+    except urllib.error.HTTPError as e:
+        return e.code, json.load(e)
+
+
+def _rpc(port, method, **params):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+        ).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.load(resp)
+    if "error" in out:
+        raise RuntimeError(out["error"])
+    return out["result"]
+
+
+def _wait_status(port: int, want: str, timeout: float = 30.0) -> dict:
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        _code, body = _get_health(port)
+        last = body
+        if body["status"] == want:
+            return body
+        time.sleep(0.1)
+    raise AssertionError(f"health never reached {want!r}; last: {last}")
+
+
+def _stub_node(**over):
+    """Minimal duck-typed node for build_health: every field the
+    checks getattr their way into."""
+    ledger = over.pop("ledger", None)
+    if ledger is None:
+        ledger = HeightLedger()
+        now = time.time()
+        for h in (1, 2, 3):
+            ledger.record(
+                {"height": h, "finality_s": 0.2 if h > 1 else None, "t_commit": now}
+            )
+    verifier = over.pop(
+        "verifier", SimpleNamespace(snapshot=lambda: {"state": "closed"})
+    )
+    node = SimpleNamespace(
+        node_id="stub",
+        consensus=SimpleNamespace(
+            verifier=verifier, fatal_error=over.pop("fatal", None)
+        ),
+        blockchain_reactor=SimpleNamespace(
+            fast_sync=over.pop("fast_sync", False)
+        ),
+        statesync_reactor=None,
+        switch=SimpleNamespace(n_peers=lambda: over.pop("peers", 3)),
+        block_store=SimpleNamespace(height=3),
+        hasher=None,
+        height_ledger=ledger,
+    )
+    return node
+
+
+class TestBuildHealth:
+    def test_ok(self):
+        h = build_health(_stub_node())
+        assert h["status"] == "ok" and h["ready"]
+        assert h["checks"]["breakers"]["states"] == {"verifier": "closed"}
+        assert h["finality_slo"]["window"] == 2
+        assert h["finality_slo"]["ok"]
+
+    def test_open_breaker_degrades(self):
+        node = _stub_node(
+            verifier=SimpleNamespace(snapshot=lambda: {"state": "open"})
+        )
+        h = build_health(node)
+        assert h["status"] == "degraded" and h["ready"]
+        assert not h["checks"]["breakers"]["ok"]
+
+    def test_mesh_shrink_degrades(self):
+        node = _stub_node(
+            verifier=SimpleNamespace(
+                snapshot=lambda: {
+                    "state": "closed",
+                    "mesh": {"devices_active": 7, "devices_total": 8},
+                }
+            )
+        )
+        h = build_health(node)
+        assert h["status"] == "degraded"
+        assert not h["checks"]["mesh"]["ok"]
+        assert h["checks"]["mesh"]["devices_active"] == 7
+
+    def test_zero_peers_degrades(self):
+        h = build_health(_stub_node(peers=0))
+        assert h["status"] == "degraded"
+        assert not h["checks"]["peers"]["ok"]
+
+    def test_fast_sync_not_ready(self):
+        h = build_health(_stub_node(fast_sync=True))
+        assert h["status"] == "not_ready" and not h["ready"]
+
+    def test_fatal_consensus_not_ready(self):
+        h = build_health(_stub_node(fatal=RuntimeError("boom")))
+        assert h["status"] == "not_ready"
+        assert h["checks"]["consensus"]["fatal"] == "RuntimeError"
+
+    def test_stalled_commits_degrade(self):
+        ledger = HeightLedger()
+        ledger.record(
+            {"height": 5, "finality_s": 0.2, "t_commit": time.time() - 3600}
+        )
+        h = build_health(_stub_node(ledger=ledger))
+        assert h["status"] == "degraded"
+        assert not h["checks"]["commit_lag"]["ok"]
+
+    def test_slo_breach_reported_not_degrading(self):
+        """An SLO burn > 1 is an alert, not a routing decision: the
+        section flips its own ok bit, the status stays ok."""
+        ledger = HeightLedger()
+        now = time.time()
+        for h in range(1, 12):
+            ledger.record(
+                {"height": h, "finality_s": 5.0, "t_commit": now}
+            )
+        h = build_health(_stub_node(ledger=ledger))
+        assert not h["finality_slo"]["ok"]
+        assert h["finality_slo"]["breaches"] == 11
+        assert h["status"] == "ok"
+
+    def test_empty_ledger_is_ok(self):
+        led = HeightLedger()
+        h = build_health(_stub_node(ledger=led))
+        assert h["status"] == "ok"
+        assert h["finality_slo"]["window"] == 0
+
+
+def _resilient_factory(threshold=2, reset_s=0.5):
+    def factory(_i):
+        return ResilientVerifier(
+            HostBatchVerifier(),
+            breaker=CircuitBreaker(
+                failure_threshold=threshold, reset_timeout_s=reset_s
+            ),
+            max_retries=0,
+        )
+
+    return factory
+
+
+class TestHealthTransitions:
+    """The acceptance cycle on live full nodes, asserted via HTTP."""
+
+    def test_breaker_cycle_and_fresh_joiner(self, tmp_path):
+        from tendermint_tpu.testing.nemesis import FullNemesisNode, Nemesis
+
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(),
+            verifier_factory=_resilient_factory(),
+        ) as net:
+            net.wait_height(2, timeout=60)
+            port = net.nodes[0].rpc_port
+            code, body = _get_health(port)
+            assert code == 200 and body["status"] == "ok", body
+
+            # device dies mid-consensus -> breaker trips -> degraded
+            fail.set_device_fault("verify")
+            try:
+                net.wait_progress(delta=1, timeout=60)
+                body = _wait_status(port, "degraded", timeout=30)
+                assert not body["checks"]["breakers"]["ok"], body
+                assert body["ready"]  # degraded still serves
+            finally:
+                fail.clear_device_faults()
+
+            # heal: breaker re-probes closed -> ok again
+            body = _wait_status(port, "ok", timeout=30)
+            assert body["checks"]["breakers"]["states"]["verifier"] == "closed"
+
+            # the SLO window is live on a committing chain
+            assert body["finality_slo"]["window"] > 0
+
+            # dump_telemetry serves the ledger + per-peer vote arrivals
+            dump = _rpc(port, "dump_telemetry", heights=4)
+            assert dump["heights"] and dump["heights"][-1]["critical_path"]
+            assert dump["vote_arrivals"]
+
+            # fresh joiner: fast-syncing (no peers yet, nothing synced)
+            # -> not_ready with HTTP 503; after catching up -> ready/ok
+            joiner = FullNemesisNode(
+                4, net.genesis, net.privs, net.home, net.chain_id
+            )
+            joiner.start()
+            code, body = _get_health(joiner.rpc_port)
+            assert code == 503, body
+            assert body["status"] == "not_ready" and body["catching_up"]
+            net.add_node(joiner)
+            target = net.nodes[0].store.height + 2
+            net.wait_height(target, timeout=90)
+            body = _wait_status(joiner.rpc_port, "ok", timeout=30)
+            assert body["ready"] and not body["catching_up"]
+
+    def test_mesh_shrink_and_restore_cycle(self, tmp_path):
+        from tendermint_tpu.parallel.mesh import MeshManager
+        from tendermint_tpu.services.batcher import CoalescingVerifier
+        from tendermint_tpu.services.verifier import ShardedBatchVerifier
+        from tendermint_tpu.testing.nemesis import Nemesis
+
+        def factory(_i):
+            return CoalescingVerifier(
+                ResilientVerifier(
+                    ShardedBatchVerifier(
+                        mesh=MeshManager(executor="host", reprobe_s=0.5),
+                        min_device_batch=1,
+                    ),
+                    max_retries=0,
+                ),
+                cache_size=4096,
+            )
+
+        try:
+            with Nemesis(
+                4,
+                home=str(tmp_path),
+                node_factory=Nemesis.full_node_factory(),
+                verifier_factory=factory,
+            ) as net:
+                net.wait_height(2, timeout=60)
+                port = net.nodes[0].rpc_port
+                code, body = _get_health(port)
+                assert code == 200 and body["status"] == "ok", body
+                assert body["checks"]["mesh"]["present"]
+
+                fail.set_device_fault("shard2")  # one chip dies
+                net.wait_progress(delta=1, timeout=60)
+                body = _wait_status(port, "degraded", timeout=30)
+                assert not body["checks"]["mesh"]["ok"], body
+                assert (
+                    body["checks"]["mesh"]["devices_active"]
+                    < body["checks"]["mesh"]["devices_total"]
+                )
+                # a mesh shrink is BELOW the breaker: breakers stay green
+                assert body["checks"]["breakers"]["ok"], body
+
+                fail.clear_device_faults()  # re-probe restores the mesh
+                net.wait_progress(delta=1, timeout=60)
+                body = _wait_status(port, "ok", timeout=30)
+                assert body["checks"]["mesh"]["ok"]
+        finally:
+            fail.clear_device_faults()
+
+
+class TestHealthRoute:
+    def test_post_json_rpc_health(self, tmp_path):
+        """`health` is also a normal JSON-RPC method (the snapshot
+        without HTTP-status semantics)."""
+        from tendermint_tpu.testing.nemesis import Nemesis
+
+        with Nemesis(
+            2, home=str(tmp_path), node_factory=Nemesis.full_node_factory()
+        ) as net:
+            net.wait_height(2, timeout=60)
+            out = _rpc(net.nodes[0].rpc_port, "health")
+            assert out["status"] in ("ok", "degraded")
+            assert "finality_slo" in out and "checks" in out

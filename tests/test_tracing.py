@@ -452,10 +452,14 @@ class TestNemesisFlightDump:
         msg = str(ei.value)
         assert "synthetic fork" in msg
         assert "[flight recorder: " in msg
-        path = msg.rsplit("[flight recorder: ", 1)[1].rstrip("]")
+        path = msg.rsplit("[flight recorder: ", 1)[1].split("]", 1)[0]
         data = FlightRecorder.load(path)
         assert data["reason"] == "invariant-violation"
         assert any(e["kind"] == "round_step" for e in data["events"])
+        # the height-ledger forensic dump rides the same message
+        assert "[height ledger: " in msg
+        hpath = msg.rsplit("[height ledger: ", 1)[1].split("]", 1)[0]
+        assert json.load(open(hpath))["reason"] == "invariant-violation"
 
 
 class TestTraceTimelineTool:

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare BENCH_hotpath.json against recorded
+floors so a perf PR cannot silently regress a section it didn't mean to
+touch.
+
+The floors file (`tools/bench_floors.json`) is a list of rules over
+dotted paths into the bench JSON:
+
+    {"floors": [
+      {"path": "detail.verify.host.verifies_per_s", "min": 800,
+       "note": "host ed25519 floor"},
+      {"path": "detail.verify.host.p99_ms", "max": 50,
+       "note": "cold-start excluded from percentiles"},
+      {"path": "detail.tracing_overhead.within_3pct", "truthy": true},
+      {"path": "detail.mempool_ingress.speedup", "min": 3,
+       "optional": true, "note": "section only present with --ingress"}
+    ]}
+
+Rules: `min` / `max` bound numeric values; `truthy` requires a true
+value; `optional: true` skips (instead of failing) when the path is
+missing or null — for sections that only exist on some bench shapes
+(`--ingress`, `--mesh` on real silicon). Floors are deliberately set
+with headroom below the seeded numbers: the gate catches step-function
+regressions (a lost optimization, an accidental sync path), not CI
+machine noise.
+
+    python tools/bench_gate.py                       # repo defaults
+    python tools/bench_gate.py --bench BENCH_hotpath.json \\
+        --floors tools/bench_floors.json
+
+Exit codes: 0 all rules hold, 1 regression(s), 2 usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def resolve(obj, path: str):
+    """Walk a dotted path through dicts (and integer list indices);
+    returns (found, value)."""
+    cur = obj
+    for part in path.split("."):
+        if isinstance(cur, dict):
+            if part not in cur:
+                return False, None
+            cur = cur[part]
+        elif isinstance(cur, list):
+            try:
+                cur = cur[int(part)]
+            except (ValueError, IndexError):
+                return False, None
+        else:
+            return False, None
+    return True, cur
+
+
+def check_rule(bench: dict, rule: dict) -> tuple[str, str]:
+    """Evaluate one floor rule; returns (status, message) with status
+    in {"ok", "skip", "fail"}."""
+    path = rule.get("path", "")
+    note = f"  ({rule['note']})" if rule.get("note") else ""
+    found, value = resolve(bench, path)
+    if not found or value is None:
+        if rule.get("optional"):
+            return "skip", f"SKIP {path}: absent (optional){note}"
+        return "fail", f"FAIL {path}: missing from bench output{note}"
+    if rule.get("truthy"):
+        if bool(value):
+            return "ok", f"OK   {path} = {value!r}{note}"
+        return "fail", f"FAIL {path} = {value!r}, expected truthy{note}"
+    try:
+        num = float(value)
+    except (TypeError, ValueError):
+        return "fail", f"FAIL {path} = {value!r}, not numeric{note}"
+    lo, hi = rule.get("min"), rule.get("max")
+    if lo is not None and num < float(lo):
+        return "fail", f"FAIL {path} = {num} < floor {lo}{note}"
+    if hi is not None and num > float(hi):
+        return "fail", f"FAIL {path} = {num} > ceiling {hi}{note}"
+    bounds = []
+    if lo is not None:
+        bounds.append(f">= {lo}")
+    if hi is not None:
+        bounds.append(f"<= {hi}")
+    return "ok", f"OK   {path} = {num} ({', '.join(bounds) or 'no bound'}){note}"
+
+
+def run_gate(bench: dict, floors: dict) -> tuple[bool, list[str]]:
+    rules = floors.get("floors", [])
+    lines: list[str] = []
+    failed = 0
+    for rule in rules:
+        status, msg = check_rule(bench, rule)
+        lines.append(msg)
+        if status == "fail":
+            failed += 1
+    lines.append(
+        f"{len(rules)} rules: {len(rules) - failed} held, {failed} regressed"
+    )
+    return failed == 0, lines
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--bench", default=os.path.join(_REPO, "BENCH_hotpath.json")
+    )
+    ap.add_argument(
+        "--floors", default=os.path.join(_REPO, "tools", "bench_floors.json")
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true", help="print failures only"
+    )
+    args = ap.parse_args(argv)
+    try:
+        with open(args.bench, "r", encoding="utf-8") as f:
+            bench = json.load(f)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"bench_gate: cannot read {args.bench}: {e}\n")
+        return 2
+    try:
+        with open(args.floors, "r", encoding="utf-8") as f:
+            floors = json.load(f)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"bench_gate: cannot read {args.floors}: {e}\n")
+        return 2
+    ok, lines = run_gate(bench, floors)
+    for line in lines:
+        if not args.quiet or line.startswith("FAIL") or line is lines[-1]:
+            print(line)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

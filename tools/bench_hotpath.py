@@ -698,6 +698,134 @@ def drive_mesh_scaling(batch: int, reps: int, device_counts=(1, 2, 4, 8)) -> dic
     }
 
 
+def _finality_pctls(gaps: list[float]) -> tuple[float | None, float | None]:
+    gaps = sorted(gaps)
+    if not gaps:
+        return None, None
+    p50 = gaps[len(gaps) // 2]
+    p99 = gaps[min(len(gaps) - 1, int(0.99 * (len(gaps) - 1)))]
+    return p50, p99
+
+
+def drive_finality(
+    heights_idle: int, heights_loaded: int, n_vals: int = 4, feeders: int = 2
+) -> dict:
+    """`finality` section: commit-to-commit p50/p99 on a LIVE
+    in-process validator net (full `node.Node` instances: p2p + mempool
+    + RPC), idle and under open-loop CheckTx traffic, read back from
+    the nodes' HeightLedgers — the exact records `/health`'s SLO window
+    and `tools/finality_report.py` consume. The regression floor for
+    ROADMAP item 3 (cross-height pipelined consensus): the pipelining
+    PR must move these numbers down, and `tools/bench_gate.py` refuses
+    a PR that silently moves them up."""
+    import tempfile
+    import threading
+
+    from tendermint_tpu.consensus.config import ConsensusConfig
+    from tendermint_tpu.testing.nemesis import Nemesis
+
+    def fast(cfg):
+        # full consensus speed (skip_timeout_commit): measure the
+        # machinery's latency, not the production commit pacing.
+        # Blocks are capped so the loaded half measures finality under
+        # steady traffic instead of degenerating into a max-throughput
+        # contest the in-process GIL always loses.
+        cfg.consensus = ConsensusConfig.test_config()
+        cfg.consensus.max_block_size_txs = 256
+
+    warm = 2
+    path_counts: dict[str, int] = {}
+
+    def summarize(recs: list[dict]) -> dict:
+        gaps = [
+            r["finality_s"]
+            for r in recs
+            if isinstance(r.get("finality_s"), (int, float))
+        ]
+        for r in recs:
+            label = r.get("critical_path")
+            if label:
+                path_counts[label] = path_counts.get(label, 0) + 1
+        p50, p99 = _finality_pctls(gaps)
+        return {
+            "heights": len(recs),
+            "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        }
+
+    with tempfile.TemporaryDirectory(prefix="hotpath-finality-") as home:
+        with Nemesis(
+            n_vals,
+            home=home,
+            node_factory=Nemesis.full_node_factory(config_mutator=fast),
+        ) as net:
+            lead = net.nodes[0]
+            net.wait_height(warm + heights_idle, timeout=180)
+            idle = summarize(
+                [
+                    r
+                    for r in lead.node.height_ledger.recent()
+                    if warm < r["height"] <= warm + heights_idle
+                ]
+            )
+            h0 = lead.store.height
+            stop = threading.Event()
+
+            def feeder(k: int) -> None:
+                # open-loop but depth-bounded: keep a steady backlog in
+                # front of the proposer without letting the pool (and
+                # the gossip fan-out) grow unboundedly — the bench
+                # measures finality under traffic, not pool growth
+                i = 0
+                while not stop.is_set():
+                    if lead.node.mempool.size() < 1024:
+                        try:
+                            lead.node.mempool.check_tx_async(
+                                b"fin%d/k%d=%d" % (k, i, i)
+                            )
+                        except Exception:
+                            return
+                        i += 1
+                    time.sleep(0.002)
+
+            threads = [
+                threading.Thread(target=feeder, args=(k,), daemon=True)
+                for k in range(feeders)
+            ]
+            for t in threads:
+                t.start()
+            try:
+                net.wait_height(h0 + heights_loaded, timeout=240)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=5)
+            loaded_recs = [
+                r
+                for r in lead.node.height_ledger.recent()
+                if h0 < r["height"] <= h0 + heights_loaded
+            ]
+            loaded = summarize(loaded_recs)
+            txs = sum(r.get("txs", 0) for r in loaded_recs)
+            span = sum(
+                r["finality_s"]
+                for r in loaded_recs
+                if isinstance(r.get("finality_s"), (int, float))
+            )
+            loaded["txs_committed"] = txs
+            loaded["committed_tx_per_s"] = round(txs / span, 1) if span else None
+    return {
+        "validators": n_vals,
+        "consensus_config": "test (skip_timeout_commit)",
+        "feeders": feeders,
+        "idle": idle,
+        "loaded": loaded,
+        "critical_path_counts": dict(
+            sorted(path_counts.items(), key=lambda kv: -kv[1])
+        ),
+    }
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -727,12 +855,95 @@ def _histo(name: str, **labels):
     )
 
 
-def backend_summary(backend: str) -> dict | None:
-    n_calls, t_total, p50, p99 = _histo(
-        "tendermint_verify_seconds", backend=backend
+def _histo_snap(name: str, **labels):
+    """Raw bucket snapshot of a histogram series (None if the family is
+    unregistered) — the baseline half of `_histo_delta`."""
+    from tendermint_tpu.telemetry import REGISTRY
+
+    fam = REGISTRY.get(name)
+    if fam is None:
+        return None
+    child = fam.labels(**labels) if fam.labelnames else fam._child0()
+    return child.value
+
+
+def _histo_delta(base, snap):
+    """(count, sum, p50, p99) of the observations BETWEEN two snapshots
+    — how the bench excludes warmup/compile calls from its percentiles:
+    the first (cold) call otherwise lands in the pool and a p99 of two
+    seconds gets reported for a sub-millisecond path. Quantiles use the
+    registry's interpolation over the diffed cumulative buckets."""
+    import math
+
+    if snap is None:
+        return 0, 0.0, None, None
+    if base is None:
+        buckets = snap["buckets"]
+        count = snap["count"]
+        total = snap["sum"]
+    else:
+        buckets = [
+            (ub, c1 - c0)
+            for (ub, c1), (_ub, c0) in zip(snap["buckets"], base["buckets"])
+        ]
+        count = snap["count"] - base["count"]
+        total = snap["sum"] - base["sum"]
+    if count <= 0:
+        return 0, 0.0, None, None
+
+    def q(qv: float) -> float:
+        rank = qv * count
+        prev_ub, prev_cum = 0.0, 0
+        for ub, cum in buckets:
+            if cum >= rank:
+                if ub == math.inf:
+                    return prev_ub
+                width = ub - prev_ub
+                in_bucket = cum - prev_cum
+                if in_bucket == 0:
+                    return ub
+                return prev_ub + width * (rank - prev_cum) / in_bucket
+            prev_ub, prev_cum = ub, cum
+        return prev_ub
+
+    return count, total, q(0.5), q(0.99)
+
+
+_VERIFY_BACKENDS = ("host", "device", "tables", "mesh")
+_HASH_BACKENDS = ("host", "device", "mesh")
+
+
+def snapshot_baselines() -> dict:
+    """Per-backend verify/hash histogram snapshots taken AFTER the
+    warmup pass — the summaries report only what happened since."""
+    base: dict = {}
+    for b in _VERIFY_BACKENDS:
+        base[("verify_seconds", b)] = _histo_snap(
+            "tendermint_verify_seconds", backend=b
+        )
+        base[("verify_batch_size", b)] = _histo_snap(
+            "tendermint_verify_batch_size", backend=b
+        )
+    for b in _HASH_BACKENDS:
+        base[("hash_seconds", b)] = _histo_snap(
+            "tendermint_hash_seconds", backend=b
+        )
+        base[("hash_batch_leaves", b)] = _histo_snap(
+            "tendermint_hash_batch_leaves", backend=b
+        )
+    return base
+
+
+def backend_summary(backend: str, base: dict | None = None) -> dict | None:
+    b = base or {}
+    n_calls, t_total, p50, p99 = _histo_delta(
+        b.get(("verify_seconds", backend)),
+        _histo_snap("tendermint_verify_seconds", backend=backend),
     )
-    n_sigs, _, _, _ = _histo("tendermint_verify_batch_size", backend=backend)
-    sig_total = _sum_of("tendermint_verify_batch_size", backend=backend)
+    _n, sig_total, _, _ = _histo_delta(
+        b.get(("verify_batch_size", backend)),
+        _histo_snap("tendermint_verify_batch_size", backend=backend),
+    )
     if n_calls == 0 or t_total <= 0:
         return None
     return {
@@ -744,9 +955,16 @@ def backend_summary(backend: str) -> dict | None:
     }
 
 
-def hash_summary(backend: str) -> dict | None:
-    n_calls, t_total, p50, p99 = _histo("tendermint_hash_seconds", backend=backend)
-    leaves = _sum_of("tendermint_hash_batch_leaves", backend=backend)
+def hash_summary(backend: str, base: dict | None = None) -> dict | None:
+    b = base or {}
+    n_calls, t_total, p50, p99 = _histo_delta(
+        b.get(("hash_seconds", backend)),
+        _histo_snap("tendermint_hash_seconds", backend=backend),
+    )
+    _n, leaves, _, _ = _histo_delta(
+        b.get(("hash_batch_leaves", backend)),
+        _histo_snap("tendermint_hash_batch_leaves", backend=backend),
+    )
     if n_calls == 0 or t_total <= 0:
         return None
     return {
@@ -756,11 +974,6 @@ def hash_summary(backend: str) -> dict | None:
         "p50_ms": round(p50 * 1e3, 3),
         "p99_ms": round(p99 * 1e3, 3),
     }
-
-
-def _sum_of(name: str, **labels) -> float:
-    _, total, _, _ = _histo(name, **labels)
-    return total
 
 
 def main(argv=None) -> int:
@@ -878,6 +1091,20 @@ def main(argv=None) -> int:
         "(kept small so the legacy run finishes; real figure is the "
         "86 ms axon tunnel)",
     )
+    ap.add_argument(
+        "--finality-heights",
+        type=int,
+        default=12,
+        dest="finality_heights",
+        help="idle heights measured in the finality section (0 skips it)",
+    )
+    ap.add_argument(
+        "--finality-loaded",
+        type=int,
+        default=10,
+        dest="finality_loaded",
+        help="heights measured under open-loop CheckTx traffic",
+    )
     args = ap.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
@@ -885,6 +1112,19 @@ def main(argv=None) -> int:
 
     on_device = jax.default_backend() != "cpu" and not args.no_device
     t0 = time.time()
+    # Warmup pass over the SAME shapes, EXCLUDED from the percentile
+    # pool (the tracing_overhead section's discipline applied to every
+    # backend summary): the first call per shape pays imports/compiles/
+    # memo fills — seconds against a sub-ms steady state — and would
+    # own the reported p99 forever.
+    sys.stderr.write("warmup pass (cold-start excluded from percentiles)...\n")
+    drive_verify_host(sizes, 1)
+    drive_hash(sizes, 1, "host")
+    if on_device:
+        drive_verify_device(sizes, 1)
+        drive_verify_tables(n_vals=max(sizes), stack=1, reps=1)
+        drive_hash(sizes, 1, "device")
+    baselines = snapshot_baselines()
     sys.stderr.write(f"driving host verify {sizes} x{args.reps}...\n")
     drive_verify_host(sizes, args.reps)
     sys.stderr.write(f"driving host merkle {sizes} x{args.reps}...\n")
@@ -906,13 +1146,13 @@ def main(argv=None) -> int:
     # per-backend verifies/s with small consensus-shaped batches
     verify_summaries = {
         b: s
-        for b in ("host", "device", "tables", "mesh")
-        if (s := backend_summary(b)) is not None
+        for b in _VERIFY_BACKENDS
+        if (s := backend_summary(b, baselines)) is not None
     }
     hash_summaries = {
         b: s
-        for b in ("host", "device", "mesh")
-        if (s := hash_summary(b)) is not None
+        for b in _HASH_BACKENDS
+        if (s := hash_summary(b, baselines)) is not None
     }
     fastsync_pipeline = None
     if args.fastsync_blocks > 0:
@@ -966,7 +1206,16 @@ def main(argv=None) -> int:
         )
         sharded_verify = drive_mesh_scaling(args.mesh_batch, args.reps)
 
+    # WAL stats are captured BEFORE the finality net runs: its four
+    # live nodes fsync their own consensus WALs into the same histogram
     wal_count, wal_sum, wal_p50, wal_p99 = _histo("tendermint_wal_fsync_seconds")
+    finality = None
+    if args.finality_heights > 0:
+        sys.stderr.write(
+            f"driving live-net finality: {args.finality_heights} idle + "
+            f"{args.finality_loaded} loaded heights x 4 validators...\n"
+        )
+        finality = drive_finality(args.finality_heights, args.finality_loaded)
     detail = {
         "wall_s": round(time.time() - t0, 2),
         "backend": jax.default_backend(),
@@ -979,6 +1228,7 @@ def main(argv=None) -> int:
         "tracing_overhead": tracing_overhead,
         "mempool_ingress": mempool_ingress,
         "sharded_verify": sharded_verify,
+        "finality": finality,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,
