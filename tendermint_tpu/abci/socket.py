@@ -90,7 +90,10 @@ class ABCISocketServer:
             except OSError:
                 return
             threading.Thread(
-                target=self._serve_conn, args=(sock,), daemon=True
+                target=self._serve_conn,
+                args=(sock,),
+                name="abci-conn",
+                daemon=True,
             ).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:

@@ -200,7 +200,11 @@ class ConsensusState:
     def start(self) -> None:
         self._catchup_replay()
         self._running = True
-        self._thread = threading.Thread(target=self._receive_loop, daemon=True)
+        # named for the contention profiler's subsystem classification
+        # (telemetry/profiler.py) — this is THE consensus hot thread
+        self._thread = threading.Thread(
+            target=self._receive_loop, name="consensus-recv", daemon=True
+        )
         self._thread.start()
         self._schedule_round0()
 
@@ -890,6 +894,7 @@ class ConsensusState:
                 threading.Thread(
                     target=self._proposal_heartbeat,
                     args=(height, round_),
+                    name="consensus-heartbeat",
                     daemon=True,
                 ).start()
             return

@@ -83,7 +83,12 @@ class MockTicker:
         self.scheduled.append(ti)
         if ti.step in self.fire_steps and self._on_timeout is not None:
             # fire on a fresh thread to mimic the async tock channel
-            t = threading.Thread(target=self._on_timeout, args=(ti,), daemon=True)
+            t = threading.Thread(
+                target=self._on_timeout,
+                args=(ti,),
+                name="consensus-timeout",
+                daemon=True,
+            )
             t.start()
 
     def stop(self) -> None:

@@ -245,6 +245,17 @@ class IngressBatcher:
                 self._cond.notify_all()
         return adm.wait()
 
+    def stats(self) -> dict:
+        """Live window state for the `dump_telemetry?profile=1` queue
+        view (the ingress leg of the queue-wait unification)."""
+        with self._cond:
+            return {
+                "window_ms": round(self._window_s * 1e3, 3),
+                "max_batch": self._max_batch,
+                "pending": len(self._queue),
+                "running": self._running,
+            }
+
     # -- flusher -----------------------------------------------------------
 
     def _flush_reason_locked(self, now: float) -> str | None:

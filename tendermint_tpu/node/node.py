@@ -409,6 +409,13 @@ class Node:
         from tendermint_tpu.telemetry.metrics import bind_node_gauges
 
         bind_node_gauges(self)
+        # contention observatory: continuous profiling when the env
+        # knob asks for it (TENDERMINT_TPU_PROFILE_HZ > 0); the
+        # process-global sampler outlives any one node, so stop() never
+        # tears it down
+        from tendermint_tpu.telemetry.profiler import maybe_start_env
+
+        maybe_start_env()
         self.switch.start()  # reactors start; consensus starts unless fast-syncing
         if self.listener is not None:
             self.listener.start_accepting()

@@ -95,9 +95,11 @@ class ChannelDescriptor:
 class _Channel:
     def __init__(self, desc: ChannelDescriptor) -> None:
         self.desc = desc
-        # (payload, trace context or None) pairs
-        self.queue: "queue.Queue[tuple[bytes, TraceContext | None]]" = queue.Queue(
-            maxsize=desc.send_queue_capacity
+        # (payload, trace context or None, enqueue perf_counter) — the
+        # timestamp feeds tendermint_p2p_send_wait_seconds when the
+        # send loop dequeues (the wait twin of the depth gauges)
+        self.queue: "queue.Queue[tuple[bytes, TraceContext | None, float]]" = (
+            queue.Queue(maxsize=desc.send_queue_capacity)
         )
         self.recently_sent = 0
 
@@ -194,7 +196,7 @@ class MConnection:
         if ctx is None:
             ctx = _trace.current()
         try:
-            ch.queue.put((payload, ctx), timeout=timeout)
+            ch.queue.put((payload, ctx, time.perf_counter()), timeout=timeout)
         except queue.Full:
             return False
         self._send_wake.set()
@@ -211,7 +213,7 @@ class MConnection:
         if ctx is None:
             ctx = _trace.current()
         try:
-            ch.queue.put_nowait((payload, ctx))
+            ch.queue.put_nowait((payload, ctx, time.perf_counter()))
         except queue.Full:
             return False
         self._send_wake.set()
@@ -249,9 +251,10 @@ class MConnection:
                     self._send_wake.clear()
                     continue
                 try:
-                    payload, ctx = ch.queue.get_nowait()
+                    payload, ctx, t_enq = ch.queue.get_nowait()
                 except queue.Empty:
                     continue
+                _metrics.P2P_SEND_WAIT.observe(time.perf_counter() - t_enq)
                 frame = build_frame(ch.desc.id, payload, ctx)
                 if ctx is not None:
                     _metrics.TRACE_PROPAGATED.inc()
@@ -303,7 +306,7 @@ class MConnection:
                     # already refreshed _last_recv above
                     if payload == _PING:
                         try:
-                            self._ctrl.queue.put_nowait((_PONG, None))
+                            self._ctrl.queue.put_nowait((_PONG, None, time.perf_counter()))
                             self._send_wake.set()
                         except queue.Full:
                             pass  # a pong is already queued
@@ -354,7 +357,7 @@ class MConnection:
             if idle > self.ping_interval and now - last_ping > self.ping_interval:
                 last_ping = now
                 try:
-                    self._ctrl.queue.put_nowait((_PING, None))
+                    self._ctrl.queue.put_nowait((_PING, None, time.perf_counter()))
                     self._send_wake.set()
                 except queue.Full:
                     pass  # a ping is already in flight

@@ -146,7 +146,10 @@ class TcpListener:
             except OSError:
                 return
             threading.Thread(
-                target=self._handshake, args=(sock, False), daemon=True
+                target=self._handshake,
+                args=(sock, False),
+                name="p2p-handshake",
+                daemon=True,
             ).start()
 
     def _handshake(self, sock: socket.socket, outbound: bool) -> None:

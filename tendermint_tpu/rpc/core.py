@@ -246,6 +246,7 @@ def make_routes(node) -> dict:
         trace_id: str = "",
         flight: int = 0,
         heights: int = 0,
+        profile: int = 0,
     ) -> dict:
         """Structured telemetry dump: the full metrics registry, the
         recent span window (consensus round phases, device dispatch),
@@ -256,8 +257,15 @@ def make_routes(node) -> dict:
         trace — the live-node half of `tools/trace_timeline.py`;
         `flight` > 0 additionally returns that many recent flight-
         recorder events; `heights` > 0 returns the last N HeightLedger
-        records (per-height phases + critical-path attribution)."""
-        from tendermint_tpu.telemetry import REGISTRY, TRACER
+        records (per-height phases + critical-path attribution);
+        `profile` > 0 returns the contention-observatory view (profiler
+        snapshot + top-contended locks + unified queue waits —
+        `tools/contention_report.py` consumes it).
+
+        High-cardinality detail (per-peer, per-thread, per-site) is
+        served ONLY here, through `telemetry/views.py` — the dump-only
+        convention (docs/OBSERVABILITY.md "Dump-only views")."""
+        from tendermint_tpu.telemetry import REGISTRY, TRACER, views
 
         breakers = {}
         for name, svc in (
@@ -283,25 +291,11 @@ def make_routes(node) -> dict:
             "metrics": REGISTRY.to_dict(),
             "spans": span_window,
             "breakers": breakers,
-            # per-peer view the exported gauges deliberately aggregate
-            # (peer-id label cardinality — docs/OBSERVABILITY.md)
-            "p2p": {
-                "send_queues": node.switch.send_queue_depths(),
-                # misbehavior scores + live bans (docs/BYZANTINE.md);
-                # absent on stub switches without a scorer
-                "misbehavior": (
-                    node.switch.scorer.snapshot()
-                    if getattr(node.switch, "scorer", None) is not None
-                    else {}
-                ),
-            },
         }
-        # per-peer vote-arrival rollup (the laggard signal
-        # tools/finality_report.py consumes) — peer-id cardinality, so
-        # dump-only like the send-queue depths above
-        arrivals = getattr(node.consensus, "vote_arrivals", None)
-        if arrivals is not None:
-            out["vote_arrivals"] = arrivals.snapshot()
+        want = ["p2p", "vote_arrivals"]
+        if int(profile) > 0:
+            want.append("profile")
+        out.update(views.collect(node, want))
         if int(flight) > 0:
             from tendermint_tpu.telemetry.flightrec import FLIGHT
 

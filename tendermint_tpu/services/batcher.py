@@ -394,6 +394,21 @@ class VerifyCoalescer:
             self._barrier = True
             self._cond.notify_all()
 
+    def stats(self) -> dict:
+        """Live window state for the `dump_telemetry?profile=1` queue
+        view: pending requests/triples per consumer, the current flush
+        window, flushes so far."""
+        with self._cond:
+            return {
+                "window_ms": round(self._window_s * 1e3, 3),
+                "max_batch": self._max_batch,
+                "flushes": self._flushes,
+                "pending_triples": self._pending_triples,
+                "pending_requests": {
+                    c: len(q) for c, q in self._queues.items() if q
+                },
+            }
+
     # -- flusher -----------------------------------------------------------
 
     def _oldest_age_locked(self, now: float) -> float | None:
@@ -612,6 +627,10 @@ class CoalescingVerifier(BatchVerifier):
         if self.cache is not None:
             out["verify_cache"] = self.cache.stats()
         return out
+
+    def stats(self) -> dict:
+        """Coalescer window state (queue-wait unification view)."""
+        return self.coalescer.stats()
 
     def prebuild(self, pubkeys) -> None:
         if hasattr(self.inner, "prebuild"):

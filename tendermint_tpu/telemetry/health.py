@@ -27,10 +27,19 @@ deliberately *reported, not folded into the status*: an SLO breach is
 an alerting decision, and a load balancer yanking a replica because the
 whole chain was slow would make the incident worse, not better.
 
+An exhausted error budget additionally **arms the trace-sampling
+boost window** (`telemetry/tracectx.boost()`) — the same reflex a
+breaker trip or mesh re-mesh has: the moment the chain is visibly slow
+is exactly when an operator wants per-message attribution, so the next
+`TENDERMINT_TPU_SLO_BOOST_S` seconds sample every trace context. The
+status itself still doesn't change (see above).
+
 Knobs (env):
   TENDERMINT_TPU_FINALITY_SLO_P99_S  p99 finality target, seconds (1.0)
   TENDERMINT_TPU_SLO_WINDOW          heights in the rolling window (64)
   TENDERMINT_TPU_SLO_BUDGET          allowed breach fraction (0.01)
+  TENDERMINT_TPU_SLO_BOOST_S         trace-boost window on budget
+                                     exhaustion, seconds (30; 0 off)
   TENDERMINT_TPU_HEALTH_MIN_PEERS    peer floor before degraded (1)
   TENDERMINT_TPU_HEALTH_MAX_LAG_S    commit-age ceiling, seconds (60)
 """
@@ -174,6 +183,17 @@ def build_health(node, ledger=None) -> dict:
         "budget_burn": round(burn, 3),
         "ok": burn <= 1.0,
     }
+    # budget exhausted -> light up tracing, the same reflex breaker
+    # trips and mesh re-meshes have (tracectx.boost): the slow window
+    # is when per-message attribution pays for itself. Reported, so an
+    # operator reading the snapshot knows sampling is boosted.
+    if gaps and not slo["ok"]:
+        boost_s = _env_float("TENDERMINT_TPU_SLO_BOOST_S", 30.0)
+        if boost_s > 0:
+            from tendermint_tpu.telemetry import tracectx
+
+            tracectx.boost(boost_s)
+            slo["trace_boosted"] = True
 
     not_ready = not (checks["consensus"]["ok"] and checks["sync"]["ok"])
     degraded = not all(

@@ -311,6 +311,76 @@ for _stage in ("drain", "verify", "e2e"):
 for _phase in ("new_height", "propose", "prevote", "precommit", "commit", "apply"):
     HEIGHT_PHASE_SECONDS.labels(phase=_phase)
 
+# -- contention observatory (telemetry/profiler.py, utils/lockrank.py) --------
+#
+# `subsystem` is the fixed classification vocabulary the profiler maps
+# thread names/stacks into (consensus, ingress, coalescer, dispatch,
+# p2p_recv, p2p_send, statesync, rpc, abci, main, other); `state` is
+# on_cpu or blocked; `wait` is the blocked-reason split (lock/io/sleep/
+# other — "other" includes runnable-but-GIL-starved). `lock` label
+# values come from the bounded utils/lockrank.py annotation vocabulary.
+# All series only advance while profiling is armed
+# (TENDERMINT_TPU_PROFILE_HZ > 0 or a profiler boost window).
+
+PROFILE_SAMPLES = Counter(
+    "tendermint_profile_samples_total",
+    "Profiler stack samples by subsystem and on-CPU/blocked state "
+    "(state=blocked carries the wait-reason split)",
+    labelnames=("subsystem", "state", "wait"),
+)
+PROFILE_TICK_SECONDS = Histogram(
+    "tendermint_profile_tick_seconds",
+    "Wall time one profiler sampling pass took (self-overhead guard)",
+    buckets=LATENCY_BUCKETS,
+)
+LOCK_WAIT_SECONDS = Histogram(
+    "tendermint_lock_wait_seconds",
+    "Blocking acquire-wait per annotated ranked lock (armed profiling "
+    "only; per-site attribution in dump_telemetry?profile=1)",
+    labelnames=("lock",),
+    buckets=LATENCY_BUCKETS,
+)
+LOCK_HOLD_SECONDS = Histogram(
+    "tendermint_lock_hold_seconds",
+    "Hold duration per annotated ranked lock (armed profiling only)",
+    labelnames=("lock",),
+    buckets=LATENCY_BUCKETS,
+)
+
+# -- process resources (telemetry/process.py) ---------------------------------
+
+PROCESS_RSS = Gauge(
+    "tendermint_process_rss_bytes", "Resident set size of this process"
+)
+PROCESS_FDS = Gauge(
+    "tendermint_process_open_fds", "Open file descriptors in this process"
+)
+PROCESS_THREADS = Gauge(
+    "tendermint_process_threads", "Live Python threads in this process"
+)
+PROCESS_GC_PAUSE = Histogram(
+    "tendermint_process_gc_pause_seconds",
+    "Stop-the-world GC collection pauses (gc.callbacks timing; "
+    "installed by telemetry/process.py install_gc_telemetry)",
+    buckets=LATENCY_BUCKETS,
+)
+PROCESS_GC_COLLECTIONS = Counter(
+    "tendermint_process_gc_collections_total",
+    "GC collections by generation",
+    labelnames=("gen",),
+)
+
+for _gen in ("0", "1", "2"):
+    PROCESS_GC_COLLECTIONS.labels(gen=_gen).inc(0)
+
+# live views cost nothing between scrapes (same discipline as the
+# node-bound gauges below, but process-scoped so no node is needed)
+from tendermint_tpu.telemetry import process as _process  # noqa: E402
+
+PROCESS_RSS.set_function(_process.rss_bytes)
+PROCESS_FDS.set_function(_process.open_fds)
+PROCESS_THREADS.set_function(_process.thread_count)
+
 # -- state sync ---------------------------------------------------------------
 
 STATESYNC_CHUNKS = Counter(
@@ -380,6 +450,14 @@ P2P_SEND_QUEUE = Gauge(
 P2P_SEND_QUEUE_MAX = Gauge(
     "tendermint_p2p_send_queue_max",
     "Deepest single-peer send queue (frames)",
+)
+# The wait twin of the depth gauges: enqueue -> send-loop dequeue per
+# frame, aggregated over all peers/channels — the p2p leg of the
+# queue-wait unification (dump_telemetry?profile=1 "queues" view).
+P2P_SEND_WAIT = Histogram(
+    "tendermint_p2p_send_wait_seconds",
+    "Time a frame waited in a peer send queue before hitting the wire",
+    buckets=LATENCY_BUCKETS,
 )
 # Adversarial-input defense (p2p/score.py + Switch.report_misbehavior):
 # `kind` is the fixed offense taxonomy (bad_frame/oversize_frame/
@@ -487,6 +565,10 @@ def bind_node_gauges(node) -> None:
     """Point the live-view gauges at a composed `node.Node`. Called from
     the node's start(); the callbacks read cheap in-memory state at
     scrape time only."""
+
+    # GC pause timing rides along: a serving node always wants it, and
+    # the hook is idempotent + process-lifetime cheap
+    _process.install_gc_telemetry()
 
     P2P_PEERS.set_function(lambda: node.switch.n_peers() if node.switch else 0)
     P2P_SEND_RATE.set_function(lambda: node.switch.send_rate_total())

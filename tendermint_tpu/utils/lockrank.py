@@ -33,12 +33,28 @@ the conftest autouse fixture turns them into test failures carrying
 ``set_raise(True)`` raises `LockRankViolation` at the offending acquire
 instead — which is what lets an ABBA regression test run to completion
 without deadlocking.
+
+**Contention timing** (the PR 12 contention observatory): when the
+profiler arms it (``set_timing(True)``, via
+``telemetry/profiler.py``), every `RankedLock` additionally records
+acquire-wait and hold durations into the
+``tendermint_lock_wait_seconds{lock}`` /
+``tendermint_lock_hold_seconds{lock}`` histograms plus a per-site
+accumulator (``contention_snapshot()`` — the top-contended view
+`dump_telemetry?profile=1` serves). The timing rides the same
+hold-stack bookkeeping the sanitizer keeps, and costs one module-global
+bool read when disarmed. Locks are only *instrumentable* when they were
+constructed as `RankedLock`s: either the sanitizer is on, or
+``TENDERMINT_TPU_PROFILE_HZ`` is set (any value, including ``0``) at
+process start so a later boost can arm timing.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 import threading
+import time
 import traceback
 
 # -- the declared rank table (normative; see docs/STATIC_ANALYSIS.md) --------
@@ -60,6 +76,11 @@ RANKS: dict[str, int] = {
     "mempool.counter": 52,  # Mempool._counter_lock
     "mempool.notif": 56,  # Mempool._notif_lock (under all lanes in update())
     "mempool.trace": 60,  # Mempool._trace_lock
+    # contention-observatory sampler state (telemetry/profiler.py):
+    # leaf-ish — held over sample aggregation only, never across a
+    # dispatch/verify call, but snapshot() is served under RPC handlers
+    # that may hold nothing, so it slots below the verify spine.
+    "telemetry.profiler": 62,
     # verify spine
     "dispatch.handle": 64,  # VerifyHandle/ChainedHandle._lock
     "batcher.shard": 68,  # VerifiedSigCache shard locks (seq = shard index)
@@ -77,6 +98,7 @@ RANKS: dict[str, int] = {
 
 _ENV = "TENDERMINT_TPU_LOCKRANK"
 _ENV_RAISE = "TENDERMINT_TPU_LOCKRANK_RAISE"
+_ENV_PROFILE = "TENDERMINT_TPU_PROFILE_HZ"  # owned by telemetry/profiler.py
 
 _STACK_FRAMES = 14  # per-side stack depth kept in edge/violation reports
 
@@ -87,6 +109,13 @@ class LockRankViolation(RuntimeError):
 
 def enabled() -> bool:
     return os.environ.get(_ENV, "0") not in ("", "0")
+
+
+def timing_capable() -> bool:
+    """True when `ranked_lock()` should hand out instrumentable
+    `RankedLock`s even without the sanitizer: the profiler env knob is
+    present (any value — ``0`` means "off now, armable later")."""
+    return os.environ.get(_ENV_PROFILE) is not None
 
 
 _raise_mode: bool | None = None
@@ -125,11 +154,16 @@ _violations: list[dict] = []
 
 
 class _Held:
-    __slots__ = ("lock", "count")
+    __slots__ = ("lock", "count", "t_acquired", "wait_s", "site")
 
     def __init__(self, lock: "RankedLock") -> None:
         self.lock = lock
         self.count = 1
+        # contention-timing stamps, set only while timing is armed at
+        # first entry (None otherwise — the pair goes unrecorded)
+        self.t_acquired: float | None = None
+        self.wait_s = 0.0
+        self.site: str | None = None
 
 
 def _capture_stack() -> list[str]:
@@ -194,6 +228,187 @@ def render_report() -> str:
         out.append(f"--- violation {i + 1} ---")
         out.append(render_violation(v))
     return "\n".join(out)
+
+
+# -- contention timing (armed by telemetry/profiler.py) -----------------------
+#
+# One module-global bool gates everything: disarmed, a timed acquire is
+# a single global read on top of the sanitizer bookkeeping. Armed, a
+# first-entry acquire stamps perf_counter around the blocking acquire
+# (wait) and the final release stamps the hold — ONE per-instance stat
+# update per acquire/release pair (the stat lock is per RankedLock, so
+# it only serializes threads already serialized on that lock).
+# Histogram observes and site capture only fire above noise floors —
+# an uncontended micro-acquire costs three perf_counter reads and a
+# handful of float ops.
+
+_TIMING = False
+# registry guard (stat creation + snapshot only — never the hot path);
+# deliberately a PLAIN lock, like _graph_lock/_viol_lock above
+_stats_lock = threading.Lock()
+_STATS: list["_LockStat"] = []
+_MAX_SITES = 16  # per-lock site table bound
+_HIST_FLOOR_S = 1e-5  # histogram observes below this are noise, dropped
+_SITE_FLOOR_S = 5e-5  # waits below this skip the sys._getframe site walk
+
+
+def set_timing(on: bool) -> None:
+    """Arm/disarm contention timing on every live RankedLock.
+    Idempotent; the profiler owns the lifecycle."""
+    global _TIMING
+    _TIMING = bool(on)
+
+
+def timing_enabled() -> bool:
+    return _TIMING
+
+
+def _acquire_site() -> str:
+    """`file.py:lineno` of the nearest caller frame outside this module
+    — the per-site attribution key. Cheap: no traceback formatting."""
+    f = sys._getframe(2)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "?"
+    return f"{f.f_code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno}"
+
+
+class _LockStat:
+    """Per-RankedLock contention accumulator (merged by name at
+    snapshot). Guarded by its own plain lock; the labeled histogram
+    children are resolved once and cached."""
+
+    __slots__ = (
+        "name",
+        "mtx",
+        "wait_count",
+        "wait_s",
+        "wait_max",
+        "hold_count",
+        "hold_s",
+        "hold_max",
+        "sites",
+        "wait_child",
+        "hold_child",
+    )
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.mtx = threading.Lock()
+        self.wait_count = 0
+        self.wait_s = 0.0
+        self.wait_max = 0.0
+        self.hold_count = 0
+        self.hold_s = 0.0
+        self.hold_max = 0.0
+        self.sites: dict[str, list] = {}
+        try:
+            from tendermint_tpu.telemetry import metrics as _m
+
+            self.wait_child = _m.LOCK_WAIT_SECONDS.labels(lock=name)
+            self.hold_child = _m.LOCK_HOLD_SECONDS.labels(lock=name)
+        except Exception:  # telemetry must never break a lock acquire
+            self.wait_child = None
+            self.hold_child = None
+
+    def record(self, wait_s: float, hold_s: float, site: str | None) -> None:
+        with self.mtx:
+            self.wait_count += 1
+            self.wait_s += wait_s
+            if wait_s > self.wait_max:
+                self.wait_max = wait_s
+            self.hold_count += 1
+            self.hold_s += hold_s
+            if hold_s > self.hold_max:
+                self.hold_max = hold_s
+            if site is not None:
+                s = self.sites.get(site)
+                if s is None:
+                    if len(self.sites) < _MAX_SITES:
+                        self.sites[site] = [1, wait_s]
+                else:
+                    s[0] += 1
+                    s[1] += wait_s
+        if wait_s >= _HIST_FLOOR_S and self.wait_child is not None:
+            self.wait_child.observe(wait_s)
+        if hold_s >= _HIST_FLOOR_S and self.hold_child is not None:
+            self.hold_child.observe(hold_s)
+
+
+def _make_stat(name: str) -> _LockStat:
+    st = _LockStat(name)
+    with _stats_lock:
+        _STATS.append(st)
+    return st
+
+
+def contention_snapshot(top: int = 10) -> dict:
+    """Top-contended locks (by total acquire-wait) merged across
+    instances sharing a name (lanes, shards), with per-site attribution
+    — the `dump_telemetry?profile=1` lock view and the
+    `tools/contention_report.py` input."""
+    with _stats_lock:
+        stats = list(_STATS)
+    merged: dict[str, dict] = {}
+    for st in stats:
+        with st.mtx:
+            if st.wait_count == 0 and st.hold_count == 0:
+                continue
+            row = merged.setdefault(
+                st.name,
+                {
+                    "lock": st.name,
+                    "wait_count": 0,
+                    "wait_s": 0.0,
+                    "wait_max_s": 0.0,
+                    "hold_count": 0,
+                    "hold_s": 0.0,
+                    "hold_max_s": 0.0,
+                    "_sites": {},
+                },
+            )
+            row["wait_count"] += st.wait_count
+            row["wait_s"] += st.wait_s
+            row["wait_max_s"] = max(row["wait_max_s"], st.wait_max)
+            row["hold_count"] += st.hold_count
+            row["hold_s"] += st.hold_s
+            row["hold_max_s"] = max(row["hold_max_s"], st.hold_max)
+            for site, (cnt, w) in st.sites.items():
+                s = row["_sites"].setdefault(site, [0, 0.0])
+                s[0] += cnt
+                s[1] += w
+    rows = []
+    for row in merged.values():
+        sites = sorted(
+            row.pop("_sites").items(), key=lambda kv: kv[1][1], reverse=True
+        )[:3]
+        row["wait_s"] = round(row["wait_s"], 6)
+        row["wait_max_s"] = round(row["wait_max_s"], 6)
+        row["hold_s"] = round(row["hold_s"], 6)
+        row["hold_max_s"] = round(row["hold_max_s"], 6)
+        row["top_sites"] = [
+            {"site": site, "count": cnt, "wait_s": round(w, 6)}
+            for site, (cnt, w) in sites
+        ]
+        rows.append(row)
+    rows.sort(key=lambda r: r["wait_s"], reverse=True)
+    return {"armed": _TIMING, "locks": rows[: max(0, top)]}
+
+
+def reset_contention() -> None:
+    with _stats_lock:
+        stats = list(_STATS)
+    for st in stats:
+        with st.mtx:
+            st.wait_count = 0
+            st.wait_s = 0.0
+            st.wait_max = 0.0
+            st.hold_count = 0
+            st.hold_s = 0.0
+            st.hold_max = 0.0
+            st.sites.clear()
 
 
 def _find_path(src: str, dst: str) -> list[str] | None:
@@ -269,10 +484,22 @@ class RankedLock:
 
     _factory = staticmethod(threading.Lock)
 
-    def __init__(self, name: str, rank: int | None = None, seq: int = 0) -> None:
+    def __init__(
+        self,
+        name: str,
+        rank: int | None = None,
+        seq: int = 0,
+        sanitize: bool = True,
+    ) -> None:
         self.name = name
         self.rank = RANKS.get(name) if rank is None else rank
         self.seq = seq
+        # False when constructed purely for contention timing (profiler
+        # env present, sanitizer off): hold-stack bookkeeping runs (the
+        # Condition protocol and timing need it) but rank/order checks
+        # don't — a timing-only process must never record violations.
+        self.sanitize = sanitize
+        self._stat: _LockStat | None = None  # lazy, first timed release
         self._inner = self._factory()
 
     # -- bookkeeping -------------------------------------------------------
@@ -356,14 +583,23 @@ class RankedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         held = self._held_entry()
-        if held is None:
+        if held is None and self.sanitize:
             self._check()
+        timed = _TIMING and held is None
+        if timed:
+            t0 = time.perf_counter()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
             if held is not None:
                 held.count += 1
             else:
-                _tls.stack.append(_Held(self))
+                h = _Held(self)
+                if timed:
+                    h.t_acquired = time.perf_counter()
+                    h.wait_s = h.t_acquired - t0
+                    if h.wait_s >= _SITE_FLOOR_S:
+                        h.site = _acquire_site()
+                _tls.stack.append(h)
         return ok
 
     def release(self) -> None:
@@ -372,7 +608,17 @@ class RankedLock:
             if stack[i].lock is self:
                 stack[i].count -= 1
                 if stack[i].count == 0:
+                    h = stack[i]
                     del stack[i]
+                    if h.t_acquired is not None:
+                        stat = self._stat
+                        if stat is None:
+                            stat = self._stat = _make_stat(self.name)
+                        stat.record(
+                            h.wait_s,
+                            time.perf_counter() - h.t_acquired,
+                            h.site,
+                        )
                 break
         self._inner.release()
 
@@ -412,13 +658,19 @@ class RankedRLock(RankedLock):
 
 def ranked_lock(name: str, rank: int | None = None, seq: int = 0):
     """A Lock carrying `name`'s declared rank — or a plain
-    `threading.Lock` when the sanitizer is off (zero overhead)."""
-    if not enabled():
-        return threading.Lock()
-    return RankedLock(name, rank, seq)
+    `threading.Lock` when neither the sanitizer nor the profiler knob
+    is on (zero overhead). With only `TENDERMINT_TPU_PROFILE_HZ` set,
+    the returned lock is timing-instrumentable but never rank-checked."""
+    if enabled():
+        return RankedLock(name, rank, seq)
+    if timing_capable():
+        return RankedLock(name, rank, seq, sanitize=False)
+    return threading.Lock()
 
 
 def ranked_rlock(name: str, rank: int | None = None, seq: int = 0):
-    if not enabled():
-        return threading.RLock()
-    return RankedRLock(name, rank, seq)
+    if enabled():
+        return RankedRLock(name, rank, seq)
+    if timing_capable():
+        return RankedRLock(name, rank, seq, sanitize=False)
+    return threading.RLock()

@@ -146,9 +146,12 @@ class TestBuildHealth:
         assert h["status"] == "degraded"
         assert not h["checks"]["commit_lag"]["ok"]
 
-    def test_slo_breach_reported_not_degrading(self):
+    def test_slo_breach_reported_not_degrading(self, monkeypatch):
         """An SLO burn > 1 is an alert, not a routing decision: the
         section flips its own ok bit, the status stays ok."""
+        # the boost reflex is covered by TestSloTraceBoost; keep this
+        # test from arming a process-wide sampling window
+        monkeypatch.setenv("TENDERMINT_TPU_SLO_BOOST_S", "0")
         ledger = HeightLedger()
         now = time.time()
         for h in range(1, 12):
@@ -165,6 +168,58 @@ class TestBuildHealth:
         h = build_health(_stub_node(ledger=led))
         assert h["status"] == "ok"
         assert h["finality_slo"]["window"] == 0
+
+
+class TestSloTraceBoost:
+    """Budget exhaustion arms the trace-sampling boost window — the
+    breaker-trip reflex applied to finality (PR 12 satellite)."""
+
+    @pytest.fixture(autouse=True)
+    def _reset_boost(self):
+        from tendermint_tpu.telemetry import tracectx as tc
+
+        tc._boost_until = 0.0
+        yield
+        tc._boost_until = 0.0
+
+    def _breaching_ledger(self):
+        ledger = HeightLedger()
+        now = time.time()
+        for h in range(1, 12):
+            ledger.record({"height": h, "finality_s": 5.0, "t_commit": now})
+        return ledger
+
+    def test_breach_lights_up_tracing(self, monkeypatch):
+        from tendermint_tpu.telemetry import tracectx as tc
+
+        monkeypatch.setenv("TENDERMINT_TPU_SLO_BOOST_S", "5")
+        assert not tc.sampling_forced()
+        h = build_health(_stub_node(ledger=self._breaching_ledger()))
+        assert not h["finality_slo"]["ok"]
+        assert h["finality_slo"]["trace_boosted"] is True
+        assert tc.sampling_forced()
+        # boosted sampling mints even at rate 0 (the boost semantics
+        # breaker trips rely on — same path, now armed by the SLO)
+        monkeypatch.setenv(tc.SAMPLE_ENV, "0")
+        assert tc.mint("slo-boost-test") is not None
+
+    def test_healthy_window_does_not_boost(self, monkeypatch):
+        from tendermint_tpu.telemetry import tracectx as tc
+
+        monkeypatch.setenv("TENDERMINT_TPU_SLO_BOOST_S", "5")
+        h = build_health(_stub_node())
+        assert h["finality_slo"]["ok"]
+        assert "trace_boosted" not in h["finality_slo"]
+        assert not tc.sampling_forced()
+
+    def test_boost_knob_zero_disables(self, monkeypatch):
+        from tendermint_tpu.telemetry import tracectx as tc
+
+        monkeypatch.setenv("TENDERMINT_TPU_SLO_BOOST_S", "0")
+        h = build_health(_stub_node(ledger=self._breaching_ledger()))
+        assert not h["finality_slo"]["ok"]
+        assert "trace_boosted" not in h["finality_slo"]
+        assert not tc.sampling_forced()
 
 
 def _resilient_factory(threshold=2, reset_s=0.5):

@@ -423,6 +423,81 @@ def drive_tracing_overhead(heights: int, n_vals: int, launch_ms: float) -> dict:
     }
 
 
+def drive_profiler_overhead(heights: int, n_vals: int, launch_ms: float) -> dict:
+    """Bench guard for the contention observatory (PR 12): verifies/s
+    on the dedup_steady_state replay with the profiler OFF vs armed at
+    the default 29 Hz WITH ranked-lock contention timing — the
+    always-on-capable configuration — must sit within 3% of off. The
+    armed run pays the real costs: the sampler walking every thread's
+    stack ~29x/s, the per-acquire perf_counter pair + stat update on
+    every instrumented lock (cache shards, coalescer window, dispatch
+    locks), and the wait/hold histogram observes."""
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+    from tendermint_tpu.telemetry.profiler import PROFILER
+    from tendermint_tpu.utils import lockrank
+
+    # locks must be *instrumentable* for the armed half: make this
+    # process timing-capable before the verifier stack constructs them
+    # (no-op under the tier-1 suite, which runs with the sanitizer on)
+    os.environ.setdefault("TENDERMINT_TPU_PROFILE_HZ", "0")
+
+    height_triples = [
+        _salted_sigs(n_vals, b"prof-h%d" % h) for h in range(heights)
+    ]
+    replays = 3  # long enough for 29 Hz to land real samples
+
+    def run() -> float:
+        v = CoalescingVerifier(
+            _LaunchLatencyVerifier(launch_ms / 1e3),
+            cache_size=65536,
+            window_s=0.001,
+        )
+        try:
+            total = 0
+            t0 = time.perf_counter()
+            for _ in range(replays):
+                for triples in height_triples:
+                    for consumer in ("consensus", "fastsync"):
+                        assert bool(
+                            v.verify_batch_async(triples, consumer=consumer)
+                            .result(timeout=60)
+                            .all()
+                        )
+                    total += 2 * len(triples)
+            return total / (time.perf_counter() - t0)
+        finally:
+            v.close()
+
+    run()  # warmup: thread spin-up / memo fills excluded
+    off_vps = run()
+    PROFILER.reset()
+    lockrank.reset_contention()
+    PROFILER.start(hz=29)
+    try:
+        on_vps = run()
+    finally:
+        PROFILER.stop()
+    snap = PROFILER.snapshot(top_stacks=5)
+    locks = lockrank.contention_snapshot(top=3)["locks"]
+    overhead_pct = 100.0 * (1.0 - on_vps / off_vps)
+    return {
+        "heights": heights,
+        "validators": n_vals,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "profile_hz": 29,
+        "lock_timing": True,
+        "profiler_off_verifies_per_s": round(off_vps, 1),
+        "profiler_on_verifies_per_s": round(on_vps, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_3pct": overhead_pct <= 3.0,
+        # proof the armed half measured something real, not a no-op
+        "samples": snap["samples"],
+        "subsystems_seen": sorted(snap["subsystems"]),
+        "top_contended_lock": locks[0]["lock"] if locks else None,
+    }
+
+
 def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> dict:
     """All four verify consumers live at once: consensus, fast-sync,
     statesync, and rpc threads submit concurrent async batches through
@@ -1190,6 +1265,15 @@ def main(argv=None) -> int:
         tracing_overhead = drive_tracing_overhead(
             args.dedup_heights, args.dedup_vals, args.launch_ms
         )
+    profiler_overhead = None
+    if args.dedup_heights > 0:
+        sys.stderr.write(
+            f"driving profiler overhead guard {args.dedup_heights} heights x "
+            f"{args.dedup_vals} vals (off vs 29 Hz + lock timing)...\n"
+        )
+        profiler_overhead = drive_profiler_overhead(
+            args.dedup_heights, args.dedup_vals, args.launch_ms
+        )
     mempool_ingress = None
     if args.ingress:
         sys.stderr.write(
@@ -1226,6 +1310,7 @@ def main(argv=None) -> int:
         "dedup_steady_state": dedup_steady_state,
         "coalesce_multiconsumer": coalesce_multiconsumer,
         "tracing_overhead": tracing_overhead,
+        "profiler_overhead": profiler_overhead,
         "mempool_ingress": mempool_ingress,
         "sharded_verify": sharded_verify,
         "finality": finality,
