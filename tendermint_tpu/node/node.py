@@ -116,6 +116,15 @@ class Node:
             path=os.path.join(cfg.home, cfg.base.db_dir, "heights.jsonl"),
             node_id=self.node_id,
         )
+        # device observatory: the process-wide launch ledger persists
+        # under this node's data dir (one record per device launch —
+        # `dump_telemetry?launches=N`, tools/device_report.py)
+        from tendermint_tpu.telemetry.launchlog import LAUNCHLOG
+
+        LAUNCHLOG.attach(
+            os.path.join(cfg.home, cfg.base.db_dir, "launches.jsonl"),
+            node_id=self.node_id,
+        )
 
         # state + stores
         self.state_db = _db("state")

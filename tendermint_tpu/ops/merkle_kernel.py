@@ -307,6 +307,16 @@ def leaf_hashes_sharded(items: list[bytes], algo: str, manager) -> list[bytes]:
             )
             step = manager.leaf_hash_step(algo, blocks.shape[1])
             digs = step(b_pad, n_pad)
+            # device observatory: the leaf lane's pad geometry +
+            # shipped block bytes on the successful attempt only (a
+            # shard-fault retry must not double-count)
+            from tendermint_tpu.telemetry import launchlog as _launchlog
+
+            _launchlog.annotate(
+                _additive=True, rows_padded=b_pad.shape[0] - n
+            )
+            _launchlog.annotate(mesh_width=manager.n_active)
+            _launchlog.add_transfer(b_pad.nbytes + n_pad.nbytes)
             return to_bytes(np.asarray(digs)[:n])
         except ShardDeviceFault as e:
             if not manager.record_shard_fault(e.shard):

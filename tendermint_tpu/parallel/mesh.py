@@ -222,6 +222,17 @@ def _host_verify_prepared_rows(pub, r, s, h) -> np.ndarray:
 # ONE recompile process-wide, and restoring the full mesh is free.
 _STEP_CACHE: dict = {}
 _STEP_LOCK = threading.Lock()
+# compiles currently building under _STEP_LOCK — the `/health` device
+# section's "compile in progress" flag (read without the lock: a
+# single-int read is atomic enough for a health probe)
+_COMPILES_IN_PROGRESS = 0
+
+
+def compiles_in_progress() -> int:
+    """Compiled-step builds running right now (0 or 1 — builds serialize
+    on the step-cache lock). Health reports it so a load balancer can
+    tell a compile stall from a dead device."""
+    return _COMPILES_IN_PROGRESS
 
 
 class MeshManager:
@@ -385,12 +396,34 @@ class MeshManager:
     # -- compiled steps ----------------------------------------------------
 
     def _cached_step(self, program: str, build):
+        global _COMPILES_IN_PROGRESS
+
+        from tendermint_tpu.telemetry import launchlog as _launchlog
+        from tendermint_tpu.telemetry import metrics as _metrics
+
         key = (self.executor, tuple(self._all[i] for i in self.active_indices()), program)
+        compile_s = None
         with _STEP_LOCK:
             step = _STEP_CACHE.get(key)
             if step is None:
-                step = build()
+                _COMPILES_IN_PROGRESS += 1
+                t0 = time.perf_counter()
+                try:
+                    step = build()
+                finally:
+                    _COMPILES_IN_PROGRESS -= 1
+                compile_s = time.perf_counter() - t0
                 _STEP_CACHE[key] = step
+        # compile-cache telemetry outside the lock: the miss stalls the
+        # launch for the whole build, and its record carries the cost
+        if compile_s is None:
+            _metrics.MESH_COMPILE.labels(result="hit").inc()
+            _launchlog.annotate(compile="hit")
+        else:
+            _metrics.MESH_COMPILE.labels(result="miss").inc()
+            _metrics.MESH_COMPILE_SECONDS.observe(compile_s)
+            _launchlog.annotate(compile="miss")
+            _launchlog.annotate(_additive=True, compile_s=compile_s)
         return step
 
     def verify_step(self):

@@ -247,6 +247,7 @@ def make_routes(node) -> dict:
         flight: int = 0,
         heights: int = 0,
         profile: int = 0,
+        launches: int = 0,
     ) -> dict:
         """Structured telemetry dump: the full metrics registry, the
         recent span window (consensus round phases, device dispatch),
@@ -260,7 +261,9 @@ def make_routes(node) -> dict:
         records (per-height phases + critical-path attribution);
         `profile` > 0 returns the contention-observatory view (profiler
         snapshot + top-contended locks + unified queue waits —
-        `tools/contention_report.py` consumes it).
+        `tools/contention_report.py` consumes it); `launches` > 0
+        returns the last N LaunchLedger records + per-kind rollup (the
+        device observatory — `tools/device_report.py` consumes it).
 
         High-cardinality detail (per-peer, per-thread, per-site) is
         served ONLY here, through `telemetry/views.py` — the dump-only
@@ -292,9 +295,11 @@ def make_routes(node) -> dict:
             "spans": span_window,
             "breakers": breakers,
         }
-        want = ["p2p", "vote_arrivals"]
+        want: list = ["p2p", "vote_arrivals"]
         if int(profile) > 0:
             want.append("profile")
+        if int(launches) > 0:
+            want.append(("launches", {"n": int(launches)}))
         out.update(views.collect(node, want))
         if int(flight) > 0:
             from tendermint_tpu.telemetry.flightrec import FLIGHT

@@ -55,6 +55,7 @@ import numpy as np
 
 from tendermint_tpu.services.verifier import BatchVerifier, Triple
 from tendermint_tpu.telemetry import TRACER
+from tendermint_tpu.telemetry import launchlog as _launchlog
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
@@ -515,8 +516,23 @@ class VerifyCoalescer:
                 requests=len(batch),
                 triples=len(merged),
             )
+        # launch-ledger tags: the flush-level facts only the coalescer
+        # knows — consumer mix, rows the dedup cache withheld from this
+        # launch, request count — captured by the dispatch handle at
+        # submit (telemetry/launchlog.py)
+        consumer_rows: dict[str, int] = {}
+        cached_rows = 0
+        for req in batch:
+            consumer_rows[req.consumer] = consumer_rows.get(
+                req.consumer, 0
+            ) + len(req.novel)
+            cached_rows += len(req.out) - len(req.novel)
         try:
-            with _trace.use(exemplar):
+            with _launchlog.tag(
+                consumers=consumer_rows,
+                rows_cached=cached_rows,
+                requests=len(batch),
+            ), _trace.use(exemplar):
                 if hasattr(self._verifier, "verify_batch_async"):
                     handle = self._verifier.verify_batch_async(
                         merged, queue=self._queue
@@ -747,9 +763,12 @@ class CoalescingVerifier(BatchVerifier):
         if not any_novel:
             return cached
         if hasattr(self.inner, "verify_commits"):
-            grid = self.inner.verify_commits(
-                pubkeys, filtered, force_fused=force_fused
-            )
+            # the withheld lanes never reach the device — the launch
+            # record carries how many the cache saved it
+            with _launchlog.tag(rows_cached=int(cached.sum())):
+                grid = self.inner.verify_commits(
+                    pubkeys, filtered, force_fused=force_fused
+                )
             return self._merge_grid(grid, cached, novel_lanes)
         return self._flat_lane_grid(
             pubkeys, filtered, cached, novel_lanes, "default"
@@ -770,9 +789,10 @@ class CoalescingVerifier(BatchVerifier):
         if not any_novel:
             return CompletedHandle(cached)
         if hasattr(self.inner, "verify_commits_async"):
-            handle = self.inner.verify_commits_async(
-                pubkeys, filtered, queue=queue, force_fused=force_fused
-            )
+            with _launchlog.tag(rows_cached=int(cached.sum())):
+                handle = self.inner.verify_commits_async(
+                    pubkeys, filtered, queue=queue, force_fused=force_fused
+                )
             return handle.then(
                 lambda grid: self._merge_grid(grid, cached, novel_lanes)
             )

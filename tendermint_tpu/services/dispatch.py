@@ -42,6 +42,7 @@ import time
 from typing import Callable
 
 from tendermint_tpu.telemetry import TRACER
+from tendermint_tpu.telemetry import launchlog as _launchlog
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.telemetry import tracectx as _trace
 from tendermint_tpu.telemetry.flightrec import FLIGHT
@@ -86,6 +87,8 @@ class VerifyHandle:
         "_launched_at",
         "_ctx",
         "_submitted_wall",
+        "_launch_rec",
+        "_launch_tags",
     )
 
     def __init__(self, queue: "DispatchQueue", launch_fn, finalize_fn, kind: str):
@@ -106,6 +109,10 @@ class VerifyHandle:
         # records a `dispatch.launch` span against it (sampled only)
         self._ctx = _trace.current()
         self._submitted_wall = time.time() if self._ctx is not None else 0.0
+        # launch-ledger tags ambient at submit (the coalescer's consumer
+        # mix / cached-rows annotations cross threads here, like _ctx)
+        self._launch_rec = None
+        self._launch_tags = _launchlog.current_tags()
 
     # -- worker side -------------------------------------------------------
 
@@ -114,12 +121,27 @@ class VerifyHandle:
         _metrics.DISPATCH_QUEUE_WAIT.labels(queue=self._queue.name).observe(
             self._launched_at - self._submitted_at
         )
+        # one LaunchLedger record per dispatch unit: opened here so the
+        # backend's prep/launch code annotates it, closed at the
+        # consumer's finalize (telemetry/launchlog.py)
+        rec = _launchlog.begin(
+            kind=self.kind, queue=self._queue.name, tags=self._launch_tags
+        )
+        if rec is not None:
+            rec["queue_wait_s"] = self._launched_at - self._submitted_at
+            if self._ctx is not None:
+                rec["trace"] = self._ctx.trace
         try:
             with _trace.use(self._ctx):
                 self._launched = self._launch_fn()
         except BaseException as e:  # delivered at result(), never lost
             self._launch_exc = e
         finally:
+            if rec is not None:
+                now = time.perf_counter()
+                rec["host_prep_s"] = now - self._launched_at
+                rec["_t_launch_end"] = now
+                self._launch_rec = _launchlog.detach(rec)
             self._launch_fn = None  # drop closed-over prep data promptly
             FLIGHT.record(
                 "dispatch_launch",
@@ -161,6 +183,16 @@ class VerifyHandle:
         with self._lock:
             if not self._finalized:
                 self._finalized = True
+                rec, self._launch_rec = self._launch_rec, None
+                t_fin0 = time.perf_counter()
+                if rec is not None:
+                    # in-flight: kernel enqueued on the worker -> the
+                    # consumer reaches finalize (the window the
+                    # pipeline's overlap hides)
+                    rec["in_flight_s"] = max(
+                        0.0, t_fin0 - rec.get("_t_launch_end", t_fin0)
+                    )
+                    _launchlog.reattach(rec)
                 try:
                     if self._launch_exc is not None:
                         raise self._launch_exc
@@ -174,6 +206,10 @@ class VerifyHandle:
                     self._launched = None
                     self._finalize_fn = None
                     now = time.perf_counter()
+                    if rec is not None:
+                        rec["finalize_s"] = now - t_fin0
+                        rec["total_s"] = now - self._submitted_at
+                        _launchlog.commit(rec, error=self._exc)
                     blocked = now - t_join
                     total = now - self._submitted_at
                     if total > 0:

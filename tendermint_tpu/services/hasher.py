@@ -20,12 +20,18 @@ import time
 import numpy as np
 
 from tendermint_tpu.merkle import simple as host_merkle
+from tendermint_tpu.telemetry import launchlog as _launchlog
 from tendermint_tpu.telemetry import metrics as _metrics
 
 
-def _observe_hash(backend: str, leaves: int, seconds: float) -> None:
+def _observe_hash(
+    backend: str, leaves: int, seconds: float, kind: str = "hash"
+) -> None:
     _metrics.HASH_BATCH_LEAVES.labels(backend=backend).observe(leaves)
     _metrics.HASH_SECONDS.labels(backend=backend).observe(seconds)
+    # device-observatory seam: closes/annotates the ambient launch
+    # record (host micro-roots outside a dispatch handle record nothing)
+    _launchlog.observe(kind, backend, leaves, seconds)
 
 # Below this leaf count the ~60 ms per-launch dispatch floor
 # (docs/PLATFORM_NOTES.md) makes host hashlib strictly faster; the device
@@ -118,15 +124,23 @@ class TreeHasher:
                 from tendermint_tpu.ops.merkle_kernel import leaf_hashes_sharded
 
                 out = leaf_hashes_sharded(items, self.algo, self.mesh)
-                _observe_hash("mesh", len(items), time.perf_counter() - t0)
+                _observe_hash(
+                    "mesh", len(items), time.perf_counter() - t0,
+                    kind="leaf_hashes",
+                )
                 return out
             from tendermint_tpu.ops.merkle_kernel import leaf_hashes_device
 
             out = leaf_hashes_device(items, self.algo)
-            _observe_hash("device", len(items), time.perf_counter() - t0)
+            _observe_hash(
+                "device", len(items), time.perf_counter() - t0,
+                kind="leaf_hashes",
+            )
             return out
         out = [host_merkle.leaf_hash(x, self.algo) for x in items]
-        _observe_hash("host", len(items), time.perf_counter() - t0)
+        _observe_hash(
+            "host", len(items), time.perf_counter() - t0, kind="leaf_hashes"
+        )
         return out
 
     def leaf_hashes_async(self, items: list[bytes], queue=None):

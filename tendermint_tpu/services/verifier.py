@@ -25,18 +25,24 @@ from typing import Sequence
 
 import numpy as np
 
+from tendermint_tpu.telemetry import launchlog as _launchlog
 from tendermint_tpu.telemetry import metrics as _metrics
 
 Triple = tuple[bytes, bytes, bytes]  # (pubkey32, message, signature64)
 
 
-def _observe_verify(backend: str, n: int, seconds: float) -> None:
+def _observe_verify(
+    backend: str, n: int, seconds: float, kind: str = "verify"
+) -> None:
     """One verify call's worth of hot-path telemetry. Each executing
     backend reports itself, so a resilient host fallback shows up under
     backend="host" while the failed device attempt stays attributed to
-    the dispatch-failure counters."""
+    the dispatch-failure counters. Also closes/annotates the ambient
+    LaunchLedger record (telemetry/launchlog.py) — the device
+    observatory's one-record-per-launch seam."""
     _metrics.VERIFY_BATCH_SIZE.labels(backend=backend).observe(n)
     _metrics.VERIFY_SECONDS.labels(backend=backend).observe(seconds)
+    _launchlog.observe(kind, backend, n, seconds)
 
 
 class BatchVerifier:
@@ -158,11 +164,19 @@ class DeviceBatchVerifier(BatchVerifier):
             return ("host", np.zeros(0, dtype=bool))
         if len(triples) < self._min_batch:
             return ("host", self._host.verify_batch(triples))
-        from tendermint_tpu.ops.ed25519_kernel import launch_batch_verify
+        from tendermint_tpu.ops.ed25519_kernel import (
+            bucket_size,
+            launch_batch_verify,
+        )
 
         pubs, msgs, sigs = zip(*triples)
         t0 = time.perf_counter()
         launched = launch_batch_verify(list(pubs), list(msgs), list(sigs))
+        # occupancy/transfer attribution: launch_batch_verify pads to
+        # the power-of-two bucket and ships 4 (size, 32) u8 arrays
+        size = bucket_size(len(triples))
+        _launchlog.annotate(_additive=True, rows_padded=size - len(triples))
+        _launchlog.add_transfer(4 * size * 32)
         return ("device", launched, t0)
 
     def finalize_verify_batch(self, launched) -> np.ndarray:
@@ -479,6 +493,10 @@ class TableBatchVerifier(DeviceBatchVerifier):
             s, h, r, precheck = prepare_commit_lanes(pubkeys, part)
             dev = verify_tables_kernel(tables, s, h, r)
             launches.append((dev, precheck, real, len(part)))
+            _launchlog.annotate(
+                _additive=True, rows_padded=(len(part) - real) * n
+            )
+            _launchlog.add_transfer(s.nbytes + h.nbytes + r.nbytes)
         return ("device", launches, key_ok, n, k, t0)
 
     def finalize_verify_commits(self, launched) -> np.ndarray:
@@ -490,7 +508,7 @@ class TableBatchVerifier(DeviceBatchVerifier):
             out = np.asarray(dev)
             out = (out & precheck & np.tile(key_ok, part_len)).reshape(-1, n)
             out_rows.append(out[:real])
-        _observe_verify("tables", k * n, time.perf_counter() - t0)
+        _observe_verify("tables", k * n, time.perf_counter() - t0, kind="tables")
         return np.concatenate(out_rows, axis=0)
 
     def verify_commits_async(
@@ -573,6 +591,11 @@ class _MeshFlatMixin:
                 arrs = pad_rows_to([pub, r, s, h, powers], size)
                 step = m.verify_step()
                 ok, total = step(*arrs)
+                # annotated only on the successful attempt: a shard
+                # fault retried onto survivors must not double-count
+                _launchlog.annotate(_additive=True, rows_padded=size - n)
+                _launchlog.annotate(mesh_width=ndev)
+                _launchlog.add_transfer(sum(a.nbytes for a in arrs))
                 return ok, total
             except ShardDeviceFault as e:
                 if not m.record_shard_fault(e.shard):
@@ -747,9 +770,19 @@ class ShardedTableBatchVerifier(_MeshFlatMixin, TableBatchVerifier):
         with self._cache_lock:
             hit = self._sharded_tables.get(key)
         if hit is not None:
+            _metrics.TABLE_DEVICE_CACHE.labels(result="hit").inc()
             return hit, key_ok
+        _metrics.TABLE_DEVICE_CACHE.labels(result="miss").inc()
         sharding = NamedSharding(mesh_obj, _P(None, None, None, BATCH_AXIS))
+        t_put = time.perf_counter()
         placed = _jax.device_put(tables, sharding)
+        # the re-ship cost every placement-cache miss pays (GB-scale at
+        # large valsets): both the bytes and the device_put stall land
+        # on this launch's ledger record
+        _launchlog.add_transfer(int(getattr(tables, "nbytes", 0)))
+        _launchlog.annotate(
+            _additive=True, device_put_s=time.perf_counter() - t_put
+        )
         with self._cache_lock:
             self._sharded_tables[key] = placed
             while len(self._sharded_tables) > self._cache_size * 2:
@@ -850,6 +883,13 @@ class ShardedTableBatchVerifier(_MeshFlatMixin, TableBatchVerifier):
             )
             ok, _total = step(tables, s, h, r, lane_ok_s, powers)
             launches.append((ok, real, len(part)))
+            _launchlog.annotate(
+                _additive=True, rows_padded=(len(part) - real) * n
+            )
+            _launchlog.add_transfer(
+                s.nbytes + h.nbytes + r.nbytes + lane_ok_s.nbytes + powers.nbytes
+            )
+        _launchlog.annotate(mesh_width=ndev)
         return ("mesh_tables", launches, ndev, k, n, t0)
 
     def finalize_verify_commits(self, launched) -> np.ndarray:
@@ -864,7 +904,7 @@ class ShardedTableBatchVerifier(_MeshFlatMixin, TableBatchVerifier):
         for ok, real, part_len in launches:
             lanes = unshard_lanes_validator_major(np.asarray(ok), n, ndev)
             rows.append(lanes.reshape(part_len, n)[:real])
-        _observe_verify("mesh", k * n, time.perf_counter() - t0)
+        _observe_verify("mesh", k * n, time.perf_counter() - t0, kind="tables")
         return np.concatenate(rows, axis=0)
 
 

@@ -102,6 +102,15 @@ class FlightRecorder:
                 heights = heightlog.recent_records(32)
             except Exception:
                 heights = []
+            # the last K device-launch records ride along too: "which
+            # launches led into this, and where did their time go"
+            # (telemetry/launchlog.py, the device observatory)
+            try:
+                from tendermint_tpu.telemetry import launchlog
+
+                launches = launchlog.LAUNCHLOG.recent(32)
+            except Exception:
+                launches = []
             with self._lock:
                 events = list(self._events)
                 self._dump_seq += 1
@@ -119,6 +128,7 @@ class FlightRecorder:
                         "dumped_at": time.time(),
                         "events": events,
                         "heights": heights,
+                        "launches": launches,
                     },
                     f,
                     separators=(",", ":"),

@@ -34,6 +34,11 @@ is exactly when an operator wants per-message attribution, so the next
 `TENDERMINT_TPU_SLO_BOOST_S` seconds sample every trace context. The
 status itself still doesn't change (see above).
 
+The **device** section (device observatory, telemetry/launchlog.py) is
+reported under the same discipline: mesh width active/total, a
+compile-in-progress flag, and seconds since the last successful device
+launch — operator signals, never folded into the routing status.
+
 Knobs (env):
   TENDERMINT_TPU_FINALITY_SLO_P99_S  p99 finality target, seconds (1.0)
   TENDERMINT_TPU_SLO_WINDOW          heights in the rolling window (64)
@@ -111,6 +116,52 @@ def _mesh_check(node) -> dict:
         "devices_active": active,
         "devices_total": total,
     }
+
+
+def _device_section(node) -> dict:
+    """The device observatory's health view: mesh width (active/total
+    from the verifier snapshot — the same node-local object the mesh
+    check reads), whether a compiled-step build is in flight right now,
+    and the age of the last successful device launch. REPORTED, never
+    folded into the status (same discipline as the finality SLO): a
+    compile stall or a quiet device is an operator signal, not a
+    load-balancer eviction. The compile flag and launch age read the
+    process-wide mesh/step-cache and LaunchLedger — the device stack is
+    a process singleton, so in multi-node-in-process harnesses they are
+    shared across nodes (documented approximation)."""
+    svc = getattr(getattr(node, "consensus", None), "verifier", None)
+    snap = {}
+    if svc is not None and hasattr(svc, "snapshot"):
+        try:
+            snap = svc.snapshot() or {}
+        except Exception:
+            snap = {}
+    mesh = snap.get("mesh") if isinstance(snap.get("mesh"), dict) else {}
+    out: dict = {
+        "mesh_active": int(mesh.get("devices_active", 0)) if mesh else None,
+        "mesh_total": int(mesh.get("devices_total", 0)) if mesh else None,
+    }
+    try:
+        # consult the mesh module only if something already loaded it:
+        # importing it here would drag the jax kernel modules into a
+        # host-only node's health probe (seconds of import on first
+        # touch) — and an unloaded mesh module means no compiles exist
+        import sys as _sys
+
+        _mesh = _sys.modules.get("tendermint_tpu.parallel.mesh")
+        out["compile_in_progress"] = (
+            _mesh.compiles_in_progress() > 0 if _mesh is not None else False
+        )
+    except Exception:
+        out["compile_in_progress"] = False
+    try:
+        from tendermint_tpu.telemetry.launchlog import LAUNCHLOG
+
+        age = LAUNCHLOG.seconds_since_success()
+        out["last_launch_age_s"] = round(age, 3) if age is not None else None
+    except Exception:
+        out["last_launch_age_s"] = None
+    return out
 
 
 def build_health(node, ledger=None) -> dict:
@@ -209,4 +260,7 @@ def build_health(node, ledger=None) -> dict:
         "catching_up": catching_up or state_syncing,
         "checks": checks,
         "finality_slo": slo,
+        # device observatory (reported, not folded into status — the
+        # mesh *degradation* check above is what can mark degraded)
+        "device": _device_section(node),
     }

@@ -130,6 +130,43 @@ XLA_CACHE_ENABLED = Gauge(
     "1 when the persistent XLA executable cache is active",
 )
 
+# -- device observatory (telemetry/launchlog.py, tools/device_report.py) ------
+#
+# `kind` is the launch vocabulary (verify / hash / tables /
+# leaf_hashes); `state` splits a launch's shipped rows into useful
+# (requested work), padded (bucket/mesh geometry zeros — pure waste on
+# device), and cached (rows the VerifiedSigCache withheld from the
+# launch entirely); `stage` is the handle-lifecycle split (queue_wait /
+# host_prep / in_flight / finalize). Per-launch detail (consumer mix,
+# mesh width, compile attribution, exemplar trace) lives in the
+# LaunchLedger records (`dump_telemetry?launches=N`), never as labels.
+
+LAUNCH_ROWS = Counter(
+    "tendermint_launch_rows",
+    "Rows per device launch by disposition: useful (requested), padded "
+    "(shape-bucket zeros shipped to device), cached (withheld by the "
+    "verified-signature cache) — occupancy = useful / (useful + padded)",
+    labelnames=("kind", "state"),
+)
+LAUNCH_STAGE_SECONDS = Histogram(
+    "tendermint_launch_stage_seconds",
+    "Per-launch stage durations from the dispatch-handle lifecycle: "
+    "queue_wait (submit -> launch start), host_prep (lane prep + kernel "
+    "dispatch), in_flight (enqueued on device -> consumer join), "
+    "finalize (materialization blocking the consumer)",
+    labelnames=("stage",),
+    buckets=LATENCY_BUCKETS,
+)
+# byte-sized buckets: 1 KiB floor (a small lane batch) to 2 GiB (the
+# 10k-valset sharded comb tables), x4 per step
+TRANSFER_BUCKETS = tuple(float(1024 * 4**i) for i in range(11))
+LAUNCH_TRANSFER_BYTES = Histogram(
+    "tendermint_launch_transfer_bytes",
+    "Host->device bytes shipped per launch (lane arrays, padded hash "
+    "blocks, sharded-table device_put on placement-cache misses)",
+    buckets=TRANSFER_BUCKETS,
+)
+
 # -- multi-chip verify mesh (parallel/mesh.py) --------------------------------
 #
 # `direction` is the re-mesh kind: "shrink" (shard fault -> survivors)
@@ -148,6 +185,26 @@ MESH_REMESH = Counter(
     "Mesh rebuilds (shrink = onto survivors after a shard fault, "
     "restore = full mesh back after a successful re-probe)",
     labelnames=("direction",),
+)
+MESH_COMPILE = Counter(
+    "tendermint_mesh_compile_total",
+    "Compiled-step cache (_STEP_CACHE) lookups by outcome: a miss "
+    "means a launch paid an XLA compile (survivor re-mesh, new "
+    "program, fresh process)",
+    labelnames=("result",),
+)
+MESH_COMPILE_SECONDS = Histogram(
+    "tendermint_mesh_compile_seconds",
+    "Wall time one compiled-step cache miss spent building/compiling "
+    "the sharded step (the launch that pays it stalls for the duration)",
+    buckets=LATENCY_BUCKETS,
+)
+TABLE_DEVICE_CACHE = Counter(
+    "tendermint_table_device_cache_total",
+    "Per-(valset, device-set) sharded-table placement cache outcomes; "
+    "a miss re-ships the comb tables to device (device_put, GB-scale "
+    "at large valsets)",
+    labelnames=("result",),
 )
 
 # -- resilient dispatch / circuit breaker -------------------------------------
@@ -306,6 +363,14 @@ for _reason in ("window", "size", "barrier"):
     BATCHER_FLUSH.labels(reason=_reason).inc(0)
 for _direction in ("shrink", "restore"):
     MESH_REMESH.labels(direction=_direction).inc(0)
+for _result in ("hit", "miss"):
+    MESH_COMPILE.labels(result=_result).inc(0)
+    TABLE_DEVICE_CACHE.labels(result=_result).inc(0)
+for _kind in ("verify", "hash", "tables", "leaf_hashes"):
+    for _state in ("useful", "padded", "cached"):
+        LAUNCH_ROWS.labels(kind=_kind, state=_state).inc(0)
+for _stage in ("queue_wait", "host_prep", "in_flight", "finalize"):
+    LAUNCH_STAGE_SECONDS.labels(stage=_stage)
 for _stage in ("drain", "verify", "e2e"):
     VOTE_STAGE.labels(stage=_stage)
 for _phase in ("new_height", "propose", "prevote", "precommit", "commit", "apply"):

@@ -21,7 +21,8 @@ docs/OBSERVABILITY.md "Dump-only views"):
 Views: `p2p` (per-peer send queues + misbehavior scores),
 `vote_arrivals` (per-peer laggard rollup), `profile` (the contention
 observatory: profiler snapshot + top-contended locks + the unified
-queue-wait table).
+queue-wait table), `launches` (the device observatory: per-launch
+ledger records + per-kind rollup, behind `dump_telemetry?launches=N`).
 """
 
 from __future__ import annotations
@@ -43,14 +44,18 @@ def view(name: str):
 def collect(node, names) -> dict:
     """{name: built view} for every requested view that applies; an
     unknown name or a raising/None builder is silently omitted (dumps
-    degrade, never fail)."""
+    degrade, never fail). An entry may be `(name, kwargs)` to pass
+    builder parameters (the `launches=N` window size)."""
     out = {}
     for name in names:
+        kwargs = {}
+        if isinstance(name, tuple):
+            name, kwargs = name
         fn = VIEWS.get(name)
         if fn is None:
             continue
         try:
-            val = fn(node)
+            val = fn(node, **kwargs)
         except Exception:
             continue
         if val is not None:
@@ -88,6 +93,24 @@ def _vote_arrivals_view(node) -> dict | None:
     if arrivals is None:
         return None
     return arrivals.snapshot()
+
+
+@view("launches")
+def _launches_view(node, n: int = 128) -> dict:
+    """The device observatory (opt-in, `launches=N`): the newest N
+    LaunchLedger records — one per device launch, with backend, mesh
+    width, useful/padded/cached rows, stage durations, transfer bytes,
+    compile-cache disposition, consumer mix, and the exemplar trace id —
+    plus the per-kind rollup `tools/device_report.py` renders. The
+    ledger is process-wide (the launch-producing stacks are process
+    singletons), like the `profile` view's profiler."""
+    from tendermint_tpu.telemetry import launchlog
+
+    records = launchlog.LAUNCHLOG.recent(max(1, int(n)))
+    return {
+        "records": records,
+        "summary": launchlog.summarize(records),
+    }
 
 
 @view("profile")

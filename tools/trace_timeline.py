@@ -8,7 +8,11 @@ Inputs:
     `<home>/data/spans.jsonl` (`telemetry/spanlog.py`); spans carrying
     a `trace` attr are distributed-trace members;
   * flight-recorder dumps — the JSON files `telemetry/flightrec.py`
-    writes on invariant violations, consensus halts, or SIGUSR2.
+    writes on invariant violations, consensus halts, or SIGUSR2;
+  * launch ledgers (`--launches`) — the per-launch JSONL rings the
+    device observatory persists (`telemetry/launchlog.py`); records
+    carrying an exemplar trace id join the timeline as
+    `device.launch` entries, attributing device time to a traced tx.
 
 Usage:
   python tools/trace_timeline.py --spans node*/data/spans.jsonl \\
@@ -36,6 +40,7 @@ STAGES = {
     "p2p.hop": "hop",
     "batcher.flush": "flush",
     "dispatch.launch": "launch",
+    "device.launch": "launch",
     "tx.e2e": "commit",
     "vote.e2e": "verdict",
     "consensus.propose": "consensus",
@@ -98,6 +103,55 @@ def load_flight(paths: list[str]) -> list[dict]:
                 evt = dict(evt)
                 evt.setdefault("node", node)
                 out.append(evt)
+    return out
+
+
+def load_launches(paths: list[str]) -> list[dict]:
+    """Read LaunchLedger JSONL files (`launches.jsonl`, the device
+    observatory) and convert each record carrying an exemplar trace id
+    into a span-shaped `device.launch` entry — so a traced tx's
+    timeline shows the device launch its verify rode, with the rows /
+    padding / stage split as attrs. Records without a trace are
+    skipped (the ledger is exhaustive; the timeline is trace-scoped)."""
+    out: list[dict] = []
+    seen: set = set()
+    for path in _expand(paths):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(d, dict) or "kind" not in d or not d.get("trace"):
+                continue
+            end = float(d.get("t", 0.0))
+            start = end - float(d.get("total_s", 0.0))
+            key = ("device.launch", start, end, d["trace"])
+            if key in seen:
+                continue
+            seen.add(key)
+            attrs = {
+                "trace": d["trace"],
+                "node": d.get("node", ""),
+                "kind": d.get("kind"),
+                "backend": d.get("backend"),
+                "rows": d.get("rows"),
+            }
+            for k in ("rows_padded", "rows_cached", "in_flight_s", "queue"):
+                if d.get(k):
+                    attrs[k] = d[k]
+            out.append(
+                {
+                    "name": "device.launch",
+                    "start": start,
+                    "end": end,
+                    "attrs": attrs,
+                }
+            )
     return out
 
 
@@ -197,14 +251,21 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--flight", nargs="+", default=[], help="flight-recorder dump files (globs ok)"
     )
+    ap.add_argument(
+        "--launches",
+        nargs="+",
+        default=[],
+        help="LaunchLedger JSONL files — device launches join the "
+        "traced timeline (globs ok)",
+    )
     ap.add_argument("--trace", default=None, help="hex trace id to follow")
     ap.add_argument("--height", type=int, default=None, help="height to replay")
     ap.add_argument("--json", action="store_true", help="emit JSON, not text")
     args = ap.parse_args(argv)
-    if not args.spans and not args.flight:
-        ap.error("need --spans and/or --flight inputs")
+    if not args.spans and not args.flight and not args.launches:
+        ap.error("need --spans, --flight, and/or --launches inputs")
     timeline = build_timeline(
-        load_spans(args.spans),
+        load_spans(args.spans) + load_launches(args.launches),
         load_flight(args.flight),
         trace_id=args.trace,
         height=args.height,
