@@ -24,6 +24,22 @@ class ConsensusConfig:
     timeout_round_skip: int = 10_000
     timeout_round_skip_delta: int = 2_000
     skip_timeout_commit: bool = False
+    # Cross-height pipeline (ROADMAP item 3): at finalize, height H's
+    # ABCI apply + state advance run as a dispatch handle while H+1's
+    # NewHeight/Propose proceed on a speculated (no-EndBlock-changes)
+    # state; a hard join barrier guards every applied-state read. False
+    # restores the strictly serial propose→...→commit→apply ladder
+    # (also forced by TENDERMINT_TPU_PIPELINE=0).
+    pipeline_commit: bool = True
+    # Measured-latency timeouts: derive the propose/prevote/precommit
+    # waits and the commit pacing from the live HeightLedger phase
+    # percentiles + vote-arrival rollup, clamped to the fixed values
+    # above as ceilings (consensus/ticker.py AdaptiveTimeouts). False
+    # (or TENDERMINT_TPU_ADAPTIVE_TIMEOUTS=0) sleeps the fixed ladder.
+    adaptive_timeouts: bool = True
+    # floor any derived timeout so a burst of sub-ms measurements can
+    # never spin the ticker (milliseconds)
+    timeout_derived_floor: int = 2
     create_empty_blocks: bool = True
     create_empty_blocks_interval: int = 0  # seconds
     # proposer liveness ping cadence while waiting for txs in

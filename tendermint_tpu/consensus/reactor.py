@@ -587,31 +587,68 @@ class ConsensusReactor(Reactor):
         if self.switch is None:
             return
         rs = self.cs.get_round_state()
-        if rs.proposal is None or rs.proposal_block_parts is None:
-            return
-        pmsg = ProposalMessage(rs.proposal)
         for peer in self.switch.peers():
             ps: PeerState | None = peer.get(self.PEER_STATE_KEY)
-            if ps is None:
-                continue
+            if ps is not None:
+                self._push_proposal_to(peer, ps, rs)
+
+    def _push_proposal_to(self, peer: Peer, ps: PeerState, rs) -> None:
+        """Send our current proposal + the parts this peer is missing,
+        if the peer is at our height."""
+        if rs.proposal is None or rs.proposal_block_parts is None:
+            return
+        prs = ps.snapshot()
+        if prs.height != rs.height:
+            return
+        if not prs.proposal:
+            pmsg = ProposalMessage(rs.proposal)
+            if peer.try_send(DATA_CHANNEL, pmsg.encode()):
+                ps.apply_proposal(pmsg)
             prs = ps.snapshot()
-            if prs.height != rs.height:
+        for i in range(rs.proposal_block_parts.total):
+            if prs.proposal_parts is not None and prs.proposal_parts.get(i):
                 continue
-            if not prs.proposal:
-                if peer.try_send(DATA_CHANNEL, pmsg.encode()):
-                    ps.apply_proposal(pmsg)
-                prs = ps.snapshot()
-            for i in range(rs.proposal_block_parts.total):
-                if prs.proposal_parts is not None and prs.proposal_parts.get(i):
-                    continue
-                part = rs.proposal_block_parts.get_part(i)
-                if part is None:
-                    continue
-                if peer.try_send(
-                    DATA_CHANNEL,
-                    BlockPartMessage(rs.height, rs.round, part).encode(),
-                ):
-                    ps.set_has_proposal_part(rs.height, i)
+            part = rs.proposal_block_parts.get_part(i)
+            if part is None:
+                continue
+            if peer.try_send(
+                DATA_CHANNEL,
+                BlockPartMessage(rs.height, rs.round, part).encode(),
+            ):
+                ps.set_has_proposal_part(rs.height, i)
+
+    def _push_catchup(self, peer: Peer, ps: PeerState) -> None:
+        """Event-driven gossip handoff (the cross-height pipeline's peer
+        half): the moment a peer announces a height/round advance, push
+        the proposal, parts, and votes it is missing at its new position
+        instead of letting it rediscover them on the next 50 ms poll
+        tick. The poll routines remain as retry/backfill — this is the
+        same push-over-poll rationale as `_on_vote_event`, applied to
+        the commit→NewHeight handoff where a peer finishing height H
+        used to miss every push for H+1 that happened while it was
+        still finalizing."""
+        if self.fast_sync or not self._running:
+            return
+        # runs on the peer's RECV thread: never stall it behind a
+        # drowning peer's send queue — the poll routines backfill
+        if peer.send_queue_depth() > 64:
+            return
+        rs = self.cs.get_round_state()
+        prs = ps.snapshot()
+        if prs.height != rs.height:
+            return
+        # Only the PROPOSER pushes the block here: if every peer pushed
+        # its copy to every newly-advanced peer, each height's block
+        # would cross the wire once per connection (measured ~2.5x
+        # loaded finality regression). Non-proposers contribute the
+        # cheap part — votes — and the poll routines backfill parts.
+        if self.cs.is_proposer():
+            self._push_proposal_to(peer, ps, rs)
+        # one missing vote per call; bits advance so the loop terminates
+        n = 2 * len(rs.validators) + 2
+        for _ in range(n):
+            if not self._gossip_votes_same_height(peer, ps, rs, ps.snapshot()):
+                break
 
     # -- receive -----------------------------------------------------------
 
@@ -640,7 +677,15 @@ class ConsensusReactor(Reactor):
 
     def _receive_state(self, peer: Peer, ps: PeerState, msg) -> None:
         if isinstance(msg, NewRoundStepMessage):
+            prev = ps.snapshot()
             ps.apply_new_round_step(msg)
+            if msg.height != prev.height:
+                # the peer finished a height: hand it whatever it now
+                # lacks at its new one, without waiting for a gossip
+                # poll tick (round/step-only changes stay with the
+                # normal push+poll paths — pushing on every step
+                # transition measurably congests loaded nets)
+                self._push_catchup(peer, ps)
         elif isinstance(msg, CommitStepMessage):
             ps.apply_commit_step(msg)
         elif isinstance(msg, HasVoteMessage):

@@ -28,7 +28,7 @@ from dataclasses import dataclass
 
 from tendermint_tpu.consensus.config import ConsensusConfig
 from tendermint_tpu.consensus.round_state import HeightVoteSet, RoundState, RoundStepType
-from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.ticker import AdaptiveTimeouts, TimeoutInfo, TimeoutTicker
 from tendermint_tpu.consensus.wal import (
     WAL,
     EndHeightMessage,
@@ -72,6 +72,11 @@ _SENTINEL = object()
 # receive-loop-internal marker: "no new input — join the oldest
 # in-flight vote-batch preverify instead"
 _JOIN = object()
+# receive-loop-internal marker: "queue idle — join the pending
+# pipelined apply now" (an idle loop delays nothing by joining, and
+# the height's ledger record / commit events land promptly instead of
+# waiting for the next height's first barrier)
+_JOIN_APPLY = object()
 
 
 @dataclass
@@ -185,6 +190,34 @@ class ConsensusState:
         self._phase_work0 = self._height_work0
         self._val_arrivals: dict[int, tuple[str, float]] = {}
         self._apply_s = 0.0
+        # measured-latency timeout policy (falls back to the fixed
+        # config ladder while cold or opted out)
+        self.timeouts = AdaptiveTimeouts(
+            config, rollup=self.vote_arrivals, ledger=self.height_ledger
+        )
+        # Cross-height pipeline: while height H's apply flies on the
+        # apply dispatch queue, H+1 runs on a speculated state. All
+        # fields are owned by the receive-loop thread (finalize, joins,
+        # and the batch drain all run there); `stop()` drains after the
+        # loop exits.
+        self.pipeline_enabled = bool(
+            getattr(config, "pipeline_commit", False)
+        ) and os.environ.get("TENDERMINT_TPU_PIPELINE", "1") != "0"
+        self._pending_apply: dict | None = None
+        self._apply_dispatch = None  # lazy depth-1 DispatchQueue("apply")
+        # bumped when the join barrier rebuilds the valset (EndBlock
+        # changed it): preverify verdicts minted under an older gen are
+        # discarded (their sigs bound validator indexes to stale keys)
+        self._valset_gen = 0
+        # node-local pipeline counters for GET /health (reported, never
+        # folded into routing status — same discipline as the SLO)
+        self.pipeline_stats = {
+            "joins": 0,
+            "stalls": 0,
+            "valset_rebuilds": 0,
+            "overlap_s_total": 0.0,
+            "last_overlap_s": 0.0,
+        }
 
         self._update_to_state(state)
         if hasattr(self.mempool, "set_on_txs_available"):
@@ -224,8 +257,22 @@ class ConsensusState:
         self._queue.put(_SENTINEL)
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._pending_apply is not None:
+            # drain the pipeline: the in-flight apply persisted (or
+            # failed) on its worker — join so shutdown state on disk is
+            # the applied one, never a half-landed height
+            with self._mtx:
+                try:
+                    self._join_apply("shutdown")
+                except Exception as e:
+                    self.fatal_error = e
+                    import traceback
+
+                    traceback.print_exc()
         if self._vote_dispatch is not None:
             self._vote_dispatch.close()
+        if self._apply_dispatch is not None:
+            self._apply_dispatch.close()
         if self.wal is not None:
             self.wal.close()
 
@@ -288,6 +335,11 @@ class ConsensusState:
     # (SURVEY §7 hard part 3: a 10k-validator vote storm must not verify
     # 10k sigs one at a time on host while the TPU idles). Env-tunable
     # so small validator sets can opt into batched preverifies too.
+    # While a pipelined apply is in flight the gate drops to ANY run —
+    # votes preverify through the coalescer instead of their tally
+    # queuing behind the apply join (measured: the extra thread hops of
+    # universal async singles COST latency on an idle loop, so the
+    # always-async variant was reverted).
     VOTE_DRAIN_MIN = int(os.environ.get("TENDERMINT_TPU_VOTE_DRAIN_MIN", "8"))
     VOTE_DRAIN_MAX = 4096
     # Vote-batch preverifies kept in flight: while batch K's signatures
@@ -313,6 +365,14 @@ class ConsensusState:
                     item = self._queue.get_nowait()
                 except queue.Empty:
                     item = _JOIN
+            elif self._pending_apply is not None:
+                # no input queued and nothing else in flight: join the
+                # pipelined apply rather than sleeping on the queue —
+                # the overlap already ran its course
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    item = _JOIN_APPLY
             else:
                 item = self._queue.get()
             if item is _SENTINEL:
@@ -335,7 +395,7 @@ class ConsensusState:
                 item is not _JOIN
                 and isinstance(item, MsgRecord)
                 and isinstance(item.msg, Vote)
-                and not self._queue.empty()
+                and (not self._queue.empty() or self._pending_apply is not None)
             ):
                 key = (item.msg.height, item.msg.round, item.msg.type)
                 batch = [item]
@@ -361,7 +421,17 @@ class ConsensusState:
             try:
                 if item is _JOIN:
                     self._join_vote_batch(*pending.popleft())
-                elif batch is not None and len(batch) >= self.VOTE_DRAIN_MIN:
+                elif item is _JOIN_APPLY:
+                    with self._mtx:
+                        self._join_apply("idle")
+                elif batch is not None and (
+                    len(batch) >= self.VOTE_DRAIN_MIN
+                    # while an apply is in flight, runs of 2+ preverify
+                    # asynchronously instead of tallying (and joining)
+                    # behind it; singles stay on the cheap sync path —
+                    # their tally join costs at most the apply remainder
+                    or (self._pending_apply is not None and len(batch) >= 2)
+                ):
                     # submit this run's preverify and keep pulling; the
                     # depth bound joins the oldest batch first so state
                     # mutation stays in drain order
@@ -462,18 +532,24 @@ class ConsensusState:
                         raise FatalConsensusError("WAL write failed") from e
             with _trace.use(exemplar):
                 handle = self._preverify_votes_async(
-                    [rec.msg for rec in records]
+                    [rec.msg for rec in records],
+                    skip={i for i, rec in enumerate(records) if rec.self_signed},
                 )
-        return records, handle, submitted, exemplar
+            gen = self._valset_gen
+        return records, handle, submitted, exemplar, gen
 
     def _join_vote_batch(
-        self, records: list, handle, submitted: float = 0.0, exemplar=None
+        self, records: list, handle, submitted: float = 0.0, exemplar=None, gen=None
     ) -> None:
         """Pipeline stage 2: join the verdict mask, then tally each vote
         with the mask deciding which skip the in-set signature check
         (failed lanes re-verify individually so error attribution matches
         the single-vote path exactly). A dispatch-layer failure degrades
-        to all-False — every vote just re-verifies in-set."""
+        to all-False — every vote just re-verifies in-set. Verdicts
+        minted under a stale valset generation (the cross-height join
+        barrier rebuilt the set after EndBlock changes) degrade the same
+        way — the speculative preverify bound indexes to superseded
+        keys, so those votes re-verify against the real set."""
         try:
             verdicts = handle.result()
         except Exception:
@@ -489,10 +565,16 @@ class ConsensusState:
         with self._mtx:
             for rec, ok in zip(records, verdicts):
                 self._observe_vote_arrival(rec)
+                # re-checked per vote: the first tally's join can rebuild
+                # the valset mid-batch. Self-signed votes stay trusted
+                # across rebuilds — the signature is this node's own.
+                ok = rec.self_signed or (
+                    bool(ok) and (gen is None or gen == self._valset_gen)
+                )
                 try:
                     with _trace.use(rec.ctx):
                         self._handle_vote(
-                            rec.msg, rec.peer_id, preverified=bool(ok)
+                            rec.msg, rec.peer_id, preverified=ok
                         )
                     if rec.ctx is not None:
                         self._observe_vote_e2e(rec, joined)
@@ -555,7 +637,7 @@ class ConsensusState:
             )
         return self._vote_dispatch
 
-    def _preverify_votes_async(self, votes: list):
+    def _preverify_votes_async(self, votes: list, skip=None):
         """Launch the batch preverify of current-height votes against
         the current validator set; returns a handle resolving to the
         per-vote bool list (False = re-verify individually in-set).
@@ -569,6 +651,8 @@ class ConsensusState:
             verifier = default_verifier()
         idxs, triples = [], []
         for i, v in enumerate(votes):
+            if skip is not None and i in skip:
+                continue  # self-signed: trusted without a launch lane
             if v.height != self.height or self.validators is None:
                 continue
             val = self.validators.get_by_index(v.validator_index)
@@ -613,7 +697,9 @@ class ConsensusState:
             with _trace.use(getattr(item, "ctx", None)):
                 if isinstance(m, Vote):
                     self._observe_vote_arrival(item)
-                    self._handle_vote(m, item.peer_id)
+                    self._handle_vote(
+                        m, item.peer_id, preverified=getattr(item, "self_signed", False)
+                    )
                     if item.ctx is not None:
                         self._observe_vote_e2e(item, time_mod.time())
                 elif isinstance(m, Proposal):
@@ -706,9 +792,9 @@ class ConsensusState:
         self.step = RoundStepType.NEW_HEIGHT
         now = time_mod.time()
         if self.commit_time:
-            self.start_time = self.commit_time + self.config.commit_timeout()
+            self.start_time = self.commit_time + self.timeouts.commit_timeout()
         else:
-            self.start_time = now + self.config.commit_timeout()
+            self.start_time = now + self.timeouts.commit_timeout()
         validators = state.validators.copy()
         self.validators = validators
         self.proposal = None
@@ -944,10 +1030,17 @@ class ConsensusState:
         self._observe_phase("propose")
         self._new_step()
         self._schedule_timeout(
-            self.config.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
+            self.timeouts.propose_timeout(round_), height, round_, RoundStepType.PROPOSE
         )
         if self.priv_validator is not None and self.is_proposer():
-            self.decide_proposal_fn(height, round_)
+            # JOIN BARRIER: the proposal header carries the applied
+            # app_hash/validators_hash and reaps the updated mempool +
+            # evidence pool. (A stale-valset is_proposer() miss above
+            # costs at worst one proposer slot on a rotation height —
+            # liveness the next round recovers, never safety.)
+            self._join_apply("propose")
+            if self.is_proposer():
+                self.decide_proposal_fn(height, round_)
         if self._is_proposal_complete():
             self._enter_prevote(height, round_)
 
@@ -1102,6 +1195,10 @@ class ConsensusState:
             round_ == self.round and self.step >= RoundStepType.PREVOTE
         ):
             return
+        # JOIN BARRIER: prevoting validates the proposal against
+        # applied state (app_hash, EndBlock valset) — never vote for a
+        # block judged on speculation.
+        self._join_apply("prevote")
         self.round = round_
         self.step = RoundStepType.PREVOTE
         self._observe_phase("prevote")
@@ -1153,7 +1250,7 @@ class ConsensusState:
         self.step = RoundStepType.PREVOTE_WAIT
         self._new_step()
         self._schedule_timeout(
-            self.config.prevote_timeout(round_), height, round_, RoundStepType.PREVOTE_WAIT
+            self.timeouts.prevote_timeout(round_), height, round_, RoundStepType.PREVOTE_WAIT
         )
 
     def _enter_precommit(self, height: int, round_: int) -> None:
@@ -1238,7 +1335,7 @@ class ConsensusState:
         self.step = RoundStepType.PRECOMMIT_WAIT
         self._new_step()
         self._schedule_timeout(
-            self.config.precommit_timeout(round_),
+            self.timeouts.precommit_timeout(round_),
             height,
             round_,
             RoundStepType.PRECOMMIT_WAIT,
@@ -1278,9 +1375,20 @@ class ConsensusState:
             return  # wait for gossip to complete the block
         self._finalize_commit(height)
 
+    def _pipeline_on(self) -> bool:
+        """Pipelined finalize only on the live receive loop — WAL replay
+        and pre-start harness drives keep the strictly serial ladder."""
+        return self.pipeline_enabled and self._running
+
     def _finalize_commit(self, height: int) -> None:
         """Reference `finalizeCommit :1146-1243` with fail points
-        bracketing every persistence step."""
+        bracketing every persistence step.
+
+        Two tails share the persistence prefix (block save + WAL
+        ENDHEIGHT): the serial tail applies the block inline before
+        entering H+1, the pipelined tail (`_finalize_pipelined`)
+        launches the apply as a dispatch handle and enters H+1's
+        NewHeight immediately on a speculated state."""
         block = self.proposal_block
         parts = self.proposal_block_parts
         block_id = self.votes.precommits(self.commit_round).two_thirds_majority()
@@ -1304,6 +1412,9 @@ class ConsensusState:
 
             fail_point()  # ENDHEIGHT written, before ApplyBlock
             state_copy = self.state.copy()
+            if self._pipeline_on():
+                self._finalize_pipelined(height, block, parts, state_copy)
+                return
             tx_results: list[tuple[bytes, object]] = []
             t_apply = time_mod.monotonic()
             apply_block(
@@ -1324,47 +1435,9 @@ class ConsensusState:
                 # retire committed proofs + prune expired stragglers
                 self.evidence_pool.update(height, list(block.evidence))
             self._observe_phase(None)  # closes the "commit" span
-            height_wall = time_mod.monotonic() - self._height_started
-            _metrics.CONSENSUS_HEIGHT_SECONDS.observe(height_wall)
-            _metrics.CONSENSUS_COMMITS.inc()
-            _metrics.CONSENSUS_TXS_COMMITTED.inc(len(block.data.txs))
-            wall_end = time_mod.time()
-            TRACER.add(
-                "consensus.height",
-                wall_end - height_wall,
-                wall_end,
-                height=height,
-                round=self.commit_round,
-                txs=len(block.data.txs),
-            )
-            FLIGHT.record(
-                "commit",
-                height=height,
-                round=self.commit_round,
-                txs=len(block.data.txs),
-                hash=block.hash().hex()[:12],
-            )
-            self._record_height_ledger(height, block, wall_end, height_wall)
-            # close every committed traced tx: first-seen -> committed
-            # on THIS node's clock, linked back by exemplar trace id
-            take_trace = getattr(self.mempool, "take_trace", None)
-            if take_trace is not None:
-                for tx in block.data.txs:
-                    entry = take_trace(bytes(tx))
-                    if entry is None:
-                        continue
-                    tx_ctx, t_seen = entry
-                    _metrics.TX_E2E.observe(
-                        wall_end - t_seen, exemplar=tx_ctx.trace
-                    )
-                    TRACER.add(
-                        "tx.e2e",
-                        t_seen,
-                        wall_end,
-                        trace=tx_ctx.trace,
-                        origin=tx_ctx.origin,
-                        height=height,
-                    )
+            draft = self._close_height_telemetry(height, block)
+            self._record_height_ledger(draft, apply_s=self._apply_s)
+            self._close_tx_traces(block, draft["wall_end"], height)
             self._update_to_state(state_copy)
         except FatalConsensusError:
             raise
@@ -1374,6 +1447,216 @@ class ConsensusState:
             ) from e
         # Listener callbacks are external code — a raising subscriber must
         # not be escalated to a consensus halt, so fire outside the scope.
+        self._fire_commit_events(height, block, tx_results)
+        self._schedule_round0()
+        # Announce H+1's NewHeight right away (the pipelined tail does
+        # the same): peers that hear we advanced push their H+1
+        # proposal/votes immediately instead of rediscovering us on the
+        # next gossip poll tick.
+        self.event_switch.fire(ev.EVENT_NEW_ROUND_STEP, self._rs_event())
+
+    # ------------------------------------------------ pipelined finalize
+
+    def _apply_queue(self):
+        if self._apply_dispatch is None:
+            from tendermint_tpu.services.dispatch import DispatchQueue
+
+            # depth 1: at most one height's apply in flight — the next
+            # finalize can only be reached through a vote tally, which
+            # joins first. launch_ledger off: host work, not a device
+            # launch (the device observatory must not count it).
+            self._apply_dispatch = DispatchQueue(
+                depth=1, name="apply", launch_ledger=False
+            )
+        return self._apply_dispatch
+
+    def _finalize_pipelined(self, height, block, parts, state_copy) -> None:
+        """Overlapped-apply tail of `_finalize_commit`: launch height
+        H's `apply_block` (ABCI execute + state-tree hash + persist) on
+        the apply dispatch queue, then enter H+1's NewHeight on a
+        PROVISIONAL state speculated without the ABCI responses
+        (`State.speculate_next`). Everything H+1 does before the join
+        barrier (`_join_apply`) is either derivable pre-apply
+        (last_commit, heights, block ids) or degrade-safe speculation
+        (vote preverify launches); applied fields — app_hash, EndBlock
+        valset changes, the updated mempool — are only readable past a
+        join. A faulted apply surfaces at the join and halts consensus
+        exactly like the serial path, so speculative state can never
+        reach a signature on a forged fork."""
+        tx_results: list[tuple[bytes, object]] = []
+
+        def _run_apply():
+            t0 = time_mod.monotonic()
+            apply_block(
+                state_copy,
+                block,
+                parts.header,
+                self.app_conn,
+                mempool=self.mempool,
+                verifier=self.verifier,
+                tx_indexer=self.tx_indexer,
+                on_tx_result=lambda i, tx, res: tx_results.append((tx, res)),
+                hasher=self.hasher,
+            )
+            return time_mod.monotonic() - t0
+
+        handle = self._apply_queue().submit(_run_apply, kind="apply")
+        self._observe_phase(None)  # closes the "commit" span pre-apply
+        draft = self._close_height_telemetry(height, block)
+        provisional = self.state.speculate_next(block.header, parts.header)
+        self._pending_apply = {
+            "height": height,
+            "block": block,
+            "handle": handle,
+            "state": state_copy,
+            "tx_results": tx_results,
+            "draft": draft,
+            "spec_val_hash": provisional.validators.hash(),
+            "launched": time_mod.monotonic(),
+        }
+        FLIGHT.record(
+            "commit_pipelined",
+            height=height,
+            round=self.commit_round,
+            txs=len(block.data.txs),
+        )
+        self._update_to_state(provisional)
+        self._schedule_round0()
+        self.event_switch.fire(ev.EVENT_NEW_ROUND_STEP, self._rs_event())
+
+    def _join_apply(self, reason: str) -> None:
+        """The hard join barrier: block until H's in-flight apply lands,
+        swap the applied state in for the provisional one, and run the
+        post-apply bookkeeping the serial path did inline (evidence
+        retirement, ledger record, commit events). Callers sit at every
+        point that reads applied state: the proposer's block creation
+        (`_enter_propose`), prevote validation (`_enter_prevote`), and
+        current-height vote tallies. Idempotent no-op when nothing is
+        pending. Raises FatalConsensusError on a faulted apply — the
+        receive loop halts, exactly the serial failure mode."""
+        pend = self._pending_apply
+        if pend is None:
+            return
+        self._pending_apply = None
+        t0 = time_mod.monotonic()
+        stalled = not pend["handle"].done()
+        try:
+            apply_s = pend["handle"].result()
+        except FatalConsensusError:
+            raise
+        except Exception as e:
+            _metrics.PIPELINE_STALLS.labels(reason="fault").inc()
+            FLIGHT.record(
+                "pipeline_fault", height=pend["height"], error=type(e).__name__
+            )
+            raise FatalConsensusError(
+                f"pipelined apply failed at height {pend['height']}"
+            ) from e
+        stall_s = time_mod.monotonic() - t0
+        # an "idle" join blocked nothing — the loop had no input to
+        # process; only barrier joins that made H+1 wait count as stalls
+        stalled = stalled and reason != "idle"
+        if stalled:
+            _metrics.PIPELINE_STALLS.labels(reason=reason).inc()
+        overlap_s = max(0.0, min(apply_s, apply_s - stall_s))
+        self._apply_s = apply_s
+        _metrics.APPLY_OVERLAP_SECONDS.observe(overlap_s)
+        st = self.pipeline_stats
+        st["joins"] += 1
+        st["stalls"] += 1 if stalled else 0
+        st["overlap_s_total"] += overlap_s
+        st["last_overlap_s"] = overlap_s
+        applied = pend["state"]
+        height = pend["height"]
+        block = pend["block"]
+        if applied.validators.hash() != pend["spec_val_hash"]:
+            # EndBlock rotated the valset: rebuild everything H+1
+            # derived from the speculation. Nothing was consumed under
+            # it — vote tallies and the proposer's path join first — so
+            # a fresh HeightVoteSet and re-derived accum are complete.
+            base = applied.validators.copy()
+            self.votes = HeightVoteSet(applied.chain_id, self.height, base)
+            if self.round > 0:
+                vals = base.copy()
+                vals.increment_accum(self.round)
+                self.validators = vals
+            else:
+                self.validators = base
+            self._valset_gen += 1
+            self.pipeline_stats["valset_rebuilds"] += 1
+            FLIGHT.record(
+                "pipeline_valset_rebuild", height=self.height, round=self.round
+            )
+        self.state = applied
+        if self.evidence_pool is not None:
+            self.evidence_pool.update(height, list(block.evidence))
+        self._record_height_ledger(
+            pend["draft"], apply_s=apply_s, overlap_s=overlap_s, pipelined=True
+        )
+        self._close_tx_traces(block, pend["draft"]["wall_end"], height)
+        self._fire_commit_events(height, block, pend["tx_results"])
+
+    # ------------------------------------------------ commit bookkeeping
+
+    def _close_height_telemetry(self, height: int, block: Block) -> dict:
+        """Metrics + tracer spans closed at commit decide time, plus the
+        draft snapshot the ledger record is assembled from — captured
+        BEFORE `_update_to_state` wipes the per-height accumulators (the
+        pipelined tail records at the join, a height later)."""
+        height_wall = time_mod.monotonic() - self._height_started
+        _metrics.CONSENSUS_HEIGHT_SECONDS.observe(height_wall)
+        _metrics.CONSENSUS_COMMITS.inc()
+        _metrics.CONSENSUS_TXS_COMMITTED.inc(len(block.data.txs))
+        wall_end = time_mod.time()
+        TRACER.add(
+            "consensus.height",
+            wall_end - height_wall,
+            wall_end,
+            height=height,
+            round=self.commit_round,
+            txs=len(block.data.txs),
+        )
+        FLIGHT.record(
+            "commit",
+            height=height,
+            round=self.commit_round,
+            txs=len(block.data.txs),
+            hash=block.hash().hex()[:12],
+        )
+        return {
+            "height": height,
+            "round": self.commit_round,
+            "txs": len(block.data.txs),
+            "wall_end": wall_end,
+            "height_wall": height_wall,
+            "phase_acc": dict(self._phase_acc),
+            "work0": self._height_work0,
+            "work1": _heightlog.work_totals(),
+            "val_arrivals": dict(self._val_arrivals),
+        }
+
+    def _close_tx_traces(self, block: Block, wall_end: float, height: int) -> None:
+        """Close every committed traced tx: first-seen -> committed on
+        THIS node's clock, linked back by exemplar trace id."""
+        take_trace = getattr(self.mempool, "take_trace", None)
+        if take_trace is None:
+            return
+        for tx in block.data.txs:
+            entry = take_trace(bytes(tx))
+            if entry is None:
+                continue
+            tx_ctx, t_seen = entry
+            _metrics.TX_E2E.observe(wall_end - t_seen, exemplar=tx_ctx.trace)
+            TRACER.add(
+                "tx.e2e",
+                t_seen,
+                wall_end,
+                trace=tx_ctx.trace,
+                origin=tx_ctx.origin,
+                height=height,
+            )
+
+    def _fire_commit_events(self, height: int, block: Block, tx_results) -> None:
         self.event_switch.fire(ev.EVENT_NEW_BLOCK, ev.EventDataNewBlock(block))
         self.event_switch.fire(
             ev.EVENT_NEW_BLOCK_HEADER, ev.EventDataNewBlockHeader(block.header)
@@ -1396,31 +1679,40 @@ class ConsensusState:
             )
             self.event_switch.fire(ev.EVENT_TX, data)
             self.event_switch.fire(ev.event_tx(tx_hash(tx)), data)
-        self._schedule_round0()
 
     def _record_height_ledger(
-        self, height: int, block: Block, wall_end: float, height_wall: float
+        self,
+        draft: dict,
+        apply_s: float,
+        overlap_s: float = 0.0,
+        pipelined: bool = False,
     ) -> None:
-        """Assemble the height's ledger record at finalize: phase
-        durations with their wait-vs-work split, the commit-to-commit
-        gap, critical-path attribution over the candidate contributors,
-        and the laggard validator from the vote-arrival tracking.
-        Observability must never fail the commit — errors are printed,
-        not raised."""
+        """Assemble the height's ledger record from the finalize-time
+        draft: phase durations with their wait-vs-work split, the
+        commit-to-commit gap, critical-path attribution over the
+        candidate contributors, and the laggard validator from the
+        vote-arrival tracking. Pipelined records carry the overlap
+        (`apply_overlap_s`) and count only the NON-overlapped apply
+        share toward the critical path. Observability must never fail
+        the commit — errors are printed, not raised."""
         try:
-            work1 = _heightlog.work_totals()
-            w0 = self._height_work0
+            height = draft["height"]
+            wall_end = draft["wall_end"]
+            height_wall = draft["height_wall"]
+            work1 = draft["work1"]
+            w0 = draft["work0"]
+            phase_acc = draft["phase_acc"]
             verify_s = max(0.0, work1["verify"] - w0["verify"])
             hash_s = max(0.0, work1["hash"] - w0["hash"])
             coalescer_s = max(0.0, work1["coalescer"] - w0["coalescer"])
             dispatch_s = max(0.0, work1["dispatch"] - w0["dispatch"])
-            apply_s = self._apply_s
             phases: dict[str, dict] = {}
             for name in ("new_height", "propose", "prevote", "precommit", "commit"):
-                dur, work = self._phase_acc.get(name, (0.0, 0.0))
-                if name == "commit":
-                    # the commit phase closes AFTER apply; split the
-                    # apply stopwatch out so it reads as its own phase
+                dur, work = phase_acc.get(name, (0.0, 0.0))
+                if name == "commit" and not pipelined:
+                    # the serial commit phase closes AFTER apply; split
+                    # the apply stopwatch out so it reads as its own
+                    # phase (the pipelined phase closes pre-launch)
                     dur = max(0.0, dur - apply_s)
                 work = min(work, dur)
                 phases[name] = {
@@ -1450,14 +1742,14 @@ class ConsensusState:
                 "commit_wait": phases["commit"]["s"],
                 "coalescer_wait": coalescer_s,
                 "dispatch_launch": verify_s + dispatch_s,
-                "abci_apply": apply_s,
+                "abci_apply": max(0.0, apply_s - overlap_s),
                 "merkle_hash": hash_s,
             }
             critical = max(contributors, key=lambda k: contributors[k])
             laggard = None
-            if self._val_arrivals:
+            if draft["val_arrivals"]:
                 idx, (addr, delay) = max(
-                    self._val_arrivals.items(), key=lambda kv: kv[1][1]
+                    draft["val_arrivals"].items(), key=lambda kv: kv[1][1]
                 )
                 laggard = {
                     "validator": addr,
@@ -1468,8 +1760,8 @@ class ConsensusState:
             self.height_ledger.record(
                 {
                     "height": height,
-                    "round": self.commit_round,
-                    "txs": len(block.data.txs),
+                    "round": draft["round"],
+                    "txs": draft["txs"],
                     "t_start": round(wall_end - height_wall, 6),
                     "t_commit": round(wall_end, 6),
                     "height_s": round(height_wall, 6),
@@ -1480,6 +1772,8 @@ class ConsensusState:
                     "path": {k: round(v, 6) for k, v in contributors.items()},
                     "critical_path": critical,
                     "laggard": laggard,
+                    "pipelined": pipelined,
+                    "apply_overlap_s": round(overlap_s, 6),
                 }
             )
         except Exception:
@@ -1591,12 +1885,28 @@ class ConsensusState:
                 and vote.type == VOTE_TYPE_PRECOMMIT
                 and self.last_commit is not None
             ):
-                if self.last_commit.add_vote(vote, verifier=self.verifier):
+                if self.last_commit.add_vote(
+                    vote, verifier=self.verifier, preverified=preverified
+                ):
                     self.event_switch.fire(ev.EVENT_VOTE, ev.EventDataVote(vote))
+                    if (
+                        self.config.skip_timeout_commit
+                        and self.last_commit.has_all()
+                    ):
+                        # every precommit of H-1 is in: nothing left for
+                        # the commit pacing to gather — start round 0 now
+                        # (reference `handleMsg`'s skipTimeoutCommit leg)
+                        self._enter_new_round(self.height, 0)
             return
         if vote.height != self.height:
             return
 
+        # JOIN BARRIER: tallying a current-height vote binds its
+        # validator index to a pubkey — that mapping must be the
+        # post-EndBlock one. (Height-1 precommits above tally into
+        # last_commit, whose valset was final before the pipeline
+        # launched — they ride the overlap freely.)
+        self._join_apply("vote_tally")
         added = self.votes.add_vote(
             vote, peer_id, verifier=self.verifier, preverified=preverified
         )
@@ -1699,4 +2009,10 @@ class ConsensusState:
             ctx = self._proposal_ctx.rehop()
         else:
             ctx = _trace.mint(self.priv_validator.address.hex()[:12])
-        self._queue.put(MsgRecord(vote, "", ctx=ctx, arrived=time_mod.time()))
+        # self_signed: the tally trusts the signature it just produced —
+        # re-verifying our own fresh vote through the device path cost a
+        # full batch-of-1 launch per vote for nothing (replay clears the
+        # flag: WAL records re-verify)
+        self._queue.put(
+            MsgRecord(vote, "", ctx=ctx, arrived=time_mod.time(), self_signed=True)
+        )

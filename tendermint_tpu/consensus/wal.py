@@ -70,6 +70,10 @@ class MsgRecord:
     peer_id: str
     ctx: object = field(default=None, compare=False, repr=False)
     arrived: float = field(default=0.0, compare=False, repr=False)
+    # IN-MEMORY like ctx/arrived: True only for votes this node signed
+    # itself this session — the tally skips re-verifying its own fresh
+    # signature. Never WAL-encoded, so replayed records verify fully.
+    self_signed: bool = field(default=False, compare=False, repr=False)
 
 
 def _encode_record(item) -> bytes:

@@ -112,7 +112,7 @@ class VerifyHandle:
         # launch-ledger tags ambient at submit (the coalescer's consumer
         # mix / cached-rows annotations cross threads here, like _ctx)
         self._launch_rec = None
-        self._launch_tags = _launchlog.current_tags()
+        self._launch_tags = _launchlog.current_tags() if queue.launch_ledger else None
 
     # -- worker side -------------------------------------------------------
 
@@ -123,10 +123,14 @@ class VerifyHandle:
         )
         # one LaunchLedger record per dispatch unit: opened here so the
         # backend's prep/launch code annotates it, closed at the
-        # consumer's finalize (telemetry/launchlog.py)
-        rec = _launchlog.begin(
-            kind=self.kind, queue=self._queue.name, tags=self._launch_tags
-        )
+        # consumer's finalize (telemetry/launchlog.py). Queues carrying
+        # host work (the consensus apply pipeline) opt out — the device
+        # observatory must only see device launches.
+        rec = None
+        if self._queue.launch_ledger:
+            rec = _launchlog.begin(
+                kind=self.kind, queue=self._queue.name, tags=self._launch_tags
+            )
         if rec is not None:
             rec["queue_wait_s"] = self._launched_at - self._submitted_at
             if self._ctx is not None:
@@ -309,8 +313,16 @@ class DispatchQueue:
     backpressure that keeps device memory and launch backlog bounded.
     """
 
-    def __init__(self, depth: int | None = None, name: str = "default") -> None:
+    def __init__(
+        self,
+        depth: int | None = None,
+        name: str = "default",
+        launch_ledger: bool = True,
+    ) -> None:
         self.name = name
+        # False = this queue carries host-side work (e.g. the pipelined
+        # consensus apply), which must not mint device LaunchLedger rows
+        self.launch_ledger = launch_ledger
         self.depth = max(1, DISPATCH_DEPTH if depth is None else depth)
         self._sem = threading.Semaphore(self.depth)
         self._work: "queue_mod.SimpleQueue" = queue_mod.SimpleQueue()

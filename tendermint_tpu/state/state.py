@@ -284,6 +284,25 @@ class State:
     def get_validators(self) -> tuple[ValidatorSet, ValidatorSet]:
         return self.last_validators, self.validators
 
+    def speculate_next(self, header, block_parts_header: PartSetHeader) -> "State":
+        """A PROVISIONAL copy advanced past the block at `header` as if
+        EndBlock changed nothing — everything `set_block_and_validators`
+        derives without the ABCI responses (heights, block id, valset
+        rotation + accum). `app_hash` stays the PRE-apply value and the
+        copy is never persisted: the pipelined finalize enters H+1's
+        NewHeight on this while the real apply is in flight, and the
+        join barrier swaps in the applied state (rebuilding the
+        valset-derived round state in the rare EndBlock-changes case)
+        before anything reads applied fields."""
+        nxt = self.copy()
+        prev_vals = nxt.validators.copy()
+        nxt.validators.increment_accum(1)
+        nxt.last_validators = prev_vals
+        nxt.last_block_height = header.height
+        nxt.last_block_id = BlockID(header.hash(), block_parts_header)
+        nxt.last_block_time = header.time
+        return nxt
+
 
 def load_state(db: DB) -> State | None:
     raw = db.get(_STATE_KEY)

@@ -39,6 +39,11 @@ reported under the same discipline: mesh width active/total, a
 compile-in-progress flag, and seconds since the last successful device
 launch — operator signals, never folded into the routing status.
 
+The **pipeline** section (cross-height pipelined consensus,
+consensus/state.py) follows suit: whether height H's apply is in
+flight under H+1's voting right now, join-barrier stall counts, and
+the apply overlap won — reported, never folded.
+
 Knobs (env):
   TENDERMINT_TPU_FINALITY_SLO_P99_S  p99 finality target, seconds (1.0)
   TENDERMINT_TPU_SLO_WINDOW          heights in the rolling window (64)
@@ -164,6 +169,36 @@ def _device_section(node) -> dict:
     return out
 
 
+def _pipeline_section(consensus) -> dict:
+    """Cross-height pipeline state (consensus/state.py pipelined
+    finalize), REPORTED under the same never-folded discipline as the
+    SLO and device sections: whether an apply is in flight right now,
+    how often the join barrier actually stalled H+1 on H's apply, and
+    the overlap won. A stall-heavy pipeline is a tuning signal (the
+    apply dominates the height), not a routing decision."""
+    out: dict = {
+        "enabled": bool(getattr(consensus, "pipeline_enabled", False)),
+        "apply_in_flight": getattr(consensus, "_pending_apply", None) is not None,
+    }
+    stats = getattr(consensus, "pipeline_stats", None)
+    if isinstance(stats, dict):
+        joins = stats.get("joins", 0)
+        out.update(
+            {
+                "joins": joins,
+                "stalls": stats.get("stalls", 0),
+                "valset_rebuilds": stats.get("valset_rebuilds", 0),
+                "last_overlap_ms": round(stats.get("last_overlap_s", 0.0) * 1e3, 3),
+                "overlap_ms_mean": round(
+                    stats.get("overlap_s_total", 0.0) / joins * 1e3, 3
+                )
+                if joins
+                else None,
+            }
+        )
+    return out
+
+
 def build_health(node, ledger=None) -> dict:
     """The health snapshot for one composed node (`node.Node` or
     anything duck-typed close enough — every read is getattr-tolerant,
@@ -263,4 +298,7 @@ def build_health(node, ledger=None) -> dict:
         # device observatory (reported, not folded into status — the
         # mesh *degradation* check above is what can mark degraded)
         "device": _device_section(node),
+        # cross-height pipeline (reported, never folded: a stalling
+        # pipeline is slower finality, which the SLO section owns)
+        "pipeline": _pipeline_section(consensus),
     }

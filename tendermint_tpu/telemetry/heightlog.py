@@ -14,6 +14,11 @@ no new per-call plumbing):
   Precommit → Commit → Applied), each with a wait-vs-work split
   (work = device verify+hash seconds that elapsed during the phase);
 * commit-to-commit gap (`finality_s`) — the user-facing number;
+* the cross-height pipeline's accounting: `pipelined` (the apply ran
+  as a dispatch handle under H+1's voting) and `apply_overlap_s` (the
+  share of the apply that ran concurrently — subtract it from the
+  phase sum when reconciling against the gap, since overlapped apply
+  time did not extend the height);
 * **critical-path attribution**: which of {proposal wait, slowest-vote
   gather, commit wait, coalescer flush wait, dispatch launch, ABCI
   apply, Merkle hash} dominated the height;

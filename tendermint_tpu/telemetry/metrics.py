@@ -94,6 +94,38 @@ VOTE_ARRIVAL_MAX = Gauge(
     "height (the laggard-validator signal)",
 )
 
+# -- cross-height pipeline (consensus/state.py pipelined finalize) ------------
+#
+# `reason` is the fixed join-barrier vocabulary: propose (proposer
+# needed the applied app_hash/mempool), prevote (validate_block against
+# applied state), vote_tally (H+1 vote needed the post-EndBlock
+# valset), shutdown (stop() drained the pipeline), fault (the apply
+# itself failed — the pipeline drains and consensus halts). A stall is
+# a join that actually blocked H+1 progress; instant joins and the
+# receive loop's opportunistic idle-joins (nothing queued — blocking
+# delays nothing) don't count.
+
+APPLY_OVERLAP_SECONDS = Histogram(
+    "tendermint_consensus_apply_overlap_seconds",
+    "Share of height H's ABCI apply + state advance that ran "
+    "concurrently with H+1's NewHeight/Propose (pipelined finalize; "
+    "0 on the serial path)",
+    buckets=LATENCY_BUCKETS,
+)
+PIPELINE_STALLS = Counter(
+    "tendermint_consensus_pipeline_stalls_total",
+    "Join-barrier waits that actually blocked H+1 progress on H's "
+    "in-flight apply, by the barrier that stalled",
+    labelnames=("reason",),
+)
+CONSENSUS_TIMEOUT_DERIVED = Gauge(
+    "tendermint_consensus_timeout_derived_seconds",
+    "Current measured-latency-derived timeout per phase (clamped to "
+    "the configured fixed value; absent while cold-starting on the "
+    "fixed ladder)",
+    labelnames=("phase",),
+)
+
 # -- device dispatch (verify / hash hot paths) --------------------------------
 
 VERIFY_BATCH_SIZE = Histogram(
@@ -375,6 +407,10 @@ for _stage in ("drain", "verify", "e2e"):
     VOTE_STAGE.labels(stage=_stage)
 for _phase in ("new_height", "propose", "prevote", "precommit", "commit", "apply"):
     HEIGHT_PHASE_SECONDS.labels(phase=_phase)
+for _reason in ("propose", "prevote", "vote_tally", "shutdown", "fault"):
+    PIPELINE_STALLS.labels(reason=_reason).inc(0)
+for _phase in ("propose", "prevote", "precommit", "commit"):
+    CONSENSUS_TIMEOUT_DERIVED.labels(phase=_phase)
 
 # -- contention observatory (telemetry/profiler.py, utils/lockrank.py) --------
 #

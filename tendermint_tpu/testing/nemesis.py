@@ -104,6 +104,66 @@ def make_genesis(n_vals: int, chain_id: str):
     return genesis, ordered
 
 
+class FaultedApplyApp:
+    """KVStore app whose commit RAISES from `fail_from_height` on — the
+    in-process stand-in for a breaker-faulted/corrupted ABCI apply
+    landing mid-pipeline. The pipelined finalize must drain at the join
+    barrier (FatalConsensusError) and halt the node with its persisted
+    state still at the last honestly-applied height: the speculative
+    H+1 round state never reaches disk, a signature, or a commit."""
+
+    def __new__(cls, fail_from_height: int = 0):
+        from tendermint_tpu.abci.apps import KVStoreApp
+
+        class _App(KVStoreApp):
+            def commit(self) -> object:
+                if fail_from_height and self._height >= fail_from_height:
+                    raise RuntimeError(
+                        f"injected faulted apply at height {self._height}"
+                    )
+                return super().commit()
+
+        return _App()
+
+
+class ForgedHashApp:
+    """KVStore app that returns a FORGED app hash from
+    `fail_from_height` on — a node whose local execution diverges (the
+    fork attempt the no-fork invariants must prove impossible). The
+    forged node prevotes nil on every honest proposal (its state
+    disagrees), and when the honest +2/3 commits anyway, its own apply
+    of the honest block fails validation and halts it — the forged
+    state never propagates into a committed block."""
+
+    def __new__(cls, fail_from_height: int = 0):
+        from tendermint_tpu.abci.apps import KVStoreApp
+        from tendermint_tpu.abci.types import Result
+
+        class _App(KVStoreApp):
+            def commit(self) -> Result:
+                if fail_from_height and self._height >= fail_from_height:
+                    return Result(data=b"\xde\xad\xbe\xef" * 5)
+                return super().commit()
+
+        return _App()
+
+
+def one_bad_app_factory(bad_index: int, bad_app_cls, n_nodes: int, **kwargs):
+    """An `app_factory` for `Nemesis.full_node_factory` that hands node
+    `bad_index` a misbehaving app and everyone else the honest KVStore.
+    Construction order == node index (the factory is called once per
+    node, in order)."""
+    from tendermint_tpu.abci.apps import KVStoreApp
+
+    counter = iter(range(n_nodes))
+
+    def factory():
+        i = next(counter)
+        return bad_app_cls(**kwargs) if i == bad_index else KVStoreApp()
+
+    return factory
+
+
 class NemesisNode:
     """One rebuildable in-process node: durable stores + disposable
     runtime (consensus state, reactor, switch are rebuilt on restart;
@@ -158,6 +218,10 @@ class NemesisNode:
         cfg = ConsensusConfig.test_config()
         cfg.timeout_commit = 250
         cfg.skip_timeout_commit = False
+        # keep the deliberate pacing: measured-latency timeouts would
+        # shrink the 250 ms commit wait right back to full test speed
+        # and starve consensus catchup of its headroom
+        cfg.adaptive_timeouts = False
         return cfg
 
     def _build(self) -> None:

@@ -171,6 +171,68 @@ class TestSoloValidator:
             f.stop()
 
 
+class TestPipelinedFinalize:
+    """Cross-height pipelined commit (PR 14): the apply launches as a
+    dispatch handle, H+1 enters on a speculated state, and the join
+    barrier swaps the applied truth in before anything reads it."""
+
+    def test_pipelined_commit_records_overlap_and_joins(self):
+        f = Fixture(n_vals=1)
+        try:
+            f.cs.start()
+            f.wait_height(3)
+            recs = f.cs.height_ledger.recent()
+            pipelined = [r for r in recs if r.get("pipelined")]
+            assert pipelined, "no height took the pipelined tail"
+            for r in pipelined:
+                assert "apply_overlap_s" in r
+            assert f.cs.pipeline_stats["joins"] >= len(pipelined)
+            # EVENT_NEW_BLOCK fires at the join: applied state visible
+            assert f.cs.state.last_block_height >= 3
+            assert f.store.height >= 3
+        finally:
+            f.stop()
+
+    def test_env_opt_out_restores_serial(self, monkeypatch):
+        monkeypatch.setenv("TENDERMINT_TPU_PIPELINE", "0")
+        f = Fixture(n_vals=1)
+        try:
+            assert not f.cs.pipeline_enabled
+            f.cs.start()
+            f.wait_height(2)
+            assert not any(
+                r.get("pipelined") for r in f.cs.height_ledger.recent()
+            )
+        finally:
+            f.stop()
+
+    def test_endblock_valset_change_rebuilds_speculation(self):
+        """EndBlock rotating the validator set mid-pipeline: the join
+        barrier must rebuild the speculated H+1 round state (fresh
+        HeightVoteSet against the post-EndBlock set) and consensus must
+        keep committing under the new set."""
+        from tendermint_tpu.abci.types import Validator as ABCIValidator
+
+        f = Fixture(n_vals=1)
+        pub = f.cs.validators.validators[0].pub_key.data
+        orig_end_block = f.app.end_block
+
+        def end_block(height):
+            orig_end_block(height)
+            # bump our own power from height 2 on (idempotent after the
+            # first application -> exactly one speculation mismatch)
+            return [ABCIValidator(pub, 20)] if height >= 2 else []
+
+        f.app.end_block = end_block
+        try:
+            f.cs.start()
+            f.wait_height(4)
+            assert f.cs.pipeline_stats["valset_rebuilds"] >= 1
+            assert f.cs.validators.validators[0].voting_power == 20
+        finally:
+            f.stop()
+
+
 class TestQuorumProgress:
     def test_four_validators_commit_with_injected_votes(self):
         f = Fixture(n_vals=4)

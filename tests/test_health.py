@@ -163,6 +163,35 @@ class TestBuildHealth:
         assert h["finality_slo"]["breaches"] == 11
         assert h["status"] == "ok"
 
+    def test_pipeline_section_reported_not_folded(self):
+        """The cross-height pipeline state rides the reported-never-
+        folded convention: apply-in-flight and stall counts appear, the
+        status does not move."""
+        node = _stub_node()
+        node.consensus.pipeline_enabled = True
+        node.consensus._pending_apply = {"height": 3}
+        node.consensus.pipeline_stats = {
+            "joins": 4,
+            "stalls": 3,  # stall-heavy: apply dominates — still "ok"
+            "valset_rebuilds": 1,
+            "overlap_s_total": 0.08,
+            "last_overlap_s": 0.02,
+        }
+        h = build_health(node)
+        assert h["status"] == "ok"
+        p = h["pipeline"]
+        assert p["enabled"] and p["apply_in_flight"]
+        assert p["joins"] == 4 and p["stalls"] == 3
+        assert p["valset_rebuilds"] == 1
+        assert p["last_overlap_ms"] == pytest.approx(20.0)
+        assert p["overlap_ms_mean"] == pytest.approx(20.0)
+
+    def test_pipeline_section_tolerates_stub(self):
+        # a consensus stub without pipeline fields still health-checks
+        h = build_health(_stub_node())
+        assert h["pipeline"]["enabled"] is False
+        assert h["pipeline"]["apply_in_flight"] is False
+
     def test_empty_ledger_is_ok(self):
         led = HeightLedger()
         h = build_health(_stub_node(ledger=led))

@@ -259,6 +259,80 @@ class TestChaosSoak:
             net.wait_height(max(net.heights()) + 1, timeout=120)
 
 
+class TestPipelinedApplyChaos:
+    """ROADMAP item 3's chaos gate: a forged or faulted ABCI apply
+    landing MID-PIPELINE (height H's apply in flight under H+1's
+    voting) must drain at the join barrier and halt that node without
+    any speculative state reaching disk or a committed block — the
+    no-fork invariants run continuously and the whole suite runs under
+    the lock-rank sanitizer."""
+
+    def test_faulted_apply_mid_pipeline_drains_and_halts(self, tmp_path):
+        from tendermint_tpu.state.state import load_state
+        from tendermint_tpu.testing.nemesis import (
+            FaultedApplyApp,
+            one_bad_app_factory,
+        )
+
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(
+                app_factory=one_bad_app_factory(
+                    3, FaultedApplyApp, 4, fail_from_height=4
+                )
+            ),
+        ) as net:
+            # pipelining is the default config; the apply of height 4 on
+            # node 3 raises on its worker — the join barrier surfaces it
+            net.wait_height(6, nodes=[0, 1, 2], timeout=120)
+            bad = net.nodes[3]
+            deadline = time.time() + 30
+            while bad.cs.fatal_error is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert bad.cs.fatal_error is not None, "faulted apply did not halt"
+            # the speculative H+1 never landed: persisted state stopped
+            # at the last honestly-applied height
+            st = load_state(bad.node.state_db)
+            assert st.last_block_height == 3
+            net.check_no_fork()
+
+    def test_forged_apply_cannot_fork_the_chain(self, tmp_path):
+        from tendermint_tpu.state.state import load_state
+        from tendermint_tpu.testing.nemesis import (
+            ForgedHashApp,
+            one_bad_app_factory,
+        )
+
+        with Nemesis(
+            4,
+            home=str(tmp_path),
+            node_factory=Nemesis.full_node_factory(
+                app_factory=one_bad_app_factory(
+                    3, ForgedHashApp, 4, fail_from_height=3
+                )
+            ),
+        ) as net:
+            # node 3's local execution diverges at height 3; the honest
+            # +2/3 keeps committing the honest chain
+            net.wait_height(6, nodes=[0, 1, 2], timeout=120)
+            bad = net.nodes[3]
+            # the forged node halts when the honest block's apply fails
+            # validation against its diverged state
+            deadline = time.time() + 30
+            while bad.cs.fatal_error is None and time.time() < deadline:
+                time.sleep(0.1)
+            assert bad.cs.fatal_error is not None, "diverged node kept running"
+            st = load_state(bad.node.state_db)
+            assert st.app_hash == b"\xde\xad\xbe\xef" * 5
+            # no committed header ever carried the forged hash
+            honest = net.nodes[0]
+            for h in range(4, honest.store.height + 1):
+                meta = honest.store.load_block_meta(h)
+                assert meta.header.app_hash != b"\xde\xad\xbe\xef" * 5
+            net.check_no_fork()
+
+
 class TestFullNodeChaos:
     """The harness driving COMPLETE `node.Node` instances (fast-sync +
     mempool + RPC + state-sync reactors) instead of bare consensus

@@ -1,0 +1,118 @@
+"""AdaptiveTimeouts (consensus/ticker.py): measured-latency timeout
+derivation — clamping to configured ceilings, cold-start fallback to
+the fixed ladder, and byzantine arrival outliers never inflating the
+derived values past the configured fixed timeouts."""
+
+import pytest
+
+from tendermint_tpu.consensus.config import ConsensusConfig
+from tendermint_tpu.consensus.ticker import AdaptiveTimeouts
+from tendermint_tpu.telemetry import heightlog
+
+
+def _ledger_with_phases(n, propose_s=0.010, prevote_s=0.005, precommit_s=0.005):
+    led = heightlog.HeightLedger()
+    for h in range(1, n + 1):
+        led.record(
+            {
+                "height": h,
+                "phases": {
+                    "propose": {"s": propose_s},
+                    "prevote": {"s": prevote_s},
+                    "precommit": {"s": precommit_s},
+                },
+            }
+        )
+    return led
+
+
+def _rollup(peer_delays: dict):
+    """peer -> list of observed arrival delays (seconds)."""
+    r = heightlog.VoteArrivalRollup()
+    for peer, delays in peer_delays.items():
+        for d in delays:
+            r.observe(peer, d)
+    return r
+
+
+class TestAdaptiveTimeouts:
+    def test_cold_start_falls_back_to_fixed(self):
+        cfg = ConsensusConfig()  # adaptive on by default
+        at = AdaptiveTimeouts(cfg, rollup=_rollup({}), ledger=heightlog.HeightLedger())
+        # empty rollup + empty ledger: every phase sleeps the fixed ladder
+        assert at.propose_timeout(0) == cfg.propose_timeout(0)
+        assert at.prevote_timeout(0) == cfg.prevote_timeout(0)
+        assert at.precommit_timeout(0) == cfg.precommit_timeout(0)
+        assert at.commit_timeout() == cfg.commit_timeout()
+
+    def test_too_few_heights_falls_back(self):
+        cfg = ConsensusConfig()
+        led = _ledger_with_phases(AdaptiveTimeouts.MIN_HEIGHTS - 1)
+        at = AdaptiveTimeouts(cfg, rollup=_rollup({"p1": [0.001]}), ledger=led)
+        assert at.propose_timeout(0) == cfg.propose_timeout(0)
+        assert at.commit_timeout() == cfg.commit_timeout()
+
+    def test_derivation_engages_and_floors(self):
+        cfg = ConsensusConfig(timeout_derived_floor=2)
+        led = _ledger_with_phases(16, propose_s=0.010)
+        rollup = _rollup({f"p{i}": [0.001] * 4 for i in range(4)})
+        at = AdaptiveTimeouts(cfg, rollup=rollup, ledger=led)
+        # propose: p95 of 10ms phase * SAFETY(3) = 30ms, under the 3000ms fixed
+        assert at.propose_timeout(0) == pytest.approx(0.030, rel=0.01)
+        # commit: 1ms median-of-means * 3 = 3ms, over the 2ms floor
+        assert at.commit_timeout() == pytest.approx(0.003, rel=0.01)
+        # floor: sub-floor measurements can't spin the ticker
+        tiny = _rollup({f"p{i}": [0.0001] for i in range(4)})
+        at_tiny = AdaptiveTimeouts(cfg, rollup=tiny, ledger=led)
+        assert at_tiny.commit_timeout() == pytest.approx(0.002, rel=0.01)
+
+    def test_clamped_to_configured_ceiling(self):
+        """Inflated measurements (e.g. every peer slow) derive AT MOST
+        the configured fixed value — the operator's ladder is a hard
+        ceiling, not a suggestion."""
+        cfg = ConsensusConfig()
+        led = _ledger_with_phases(
+            16, propose_s=900.0, prevote_s=900.0, precommit_s=900.0
+        )
+        rollup = _rollup({f"p{i}": [50.0] * 4 for i in range(4)})
+        at = AdaptiveTimeouts(cfg, rollup=rollup, ledger=led)
+        assert at.propose_timeout(0) == cfg.propose_timeout(0)
+        assert at.prevote_timeout(1) == cfg.prevote_timeout(1)
+        assert at.commit_timeout() == cfg.commit_timeout()
+
+    def test_byzantine_outlier_cannot_inflate(self):
+        """One peer stamping absurd vote timestamps (delays clamped to
+        MAX_ARRIVAL_S at observation) moves nothing: the estimate is
+        the median of per-peer means, so a minority of liars is
+        ignored entirely."""
+        cfg = ConsensusConfig()
+        led = _ledger_with_phases(16)
+        honest = {f"p{i}": [0.002] * 8 for i in range(4)}
+        at_honest = AdaptiveTimeouts(cfg, rollup=_rollup(honest), ledger=led)
+        baseline = at_honest.commit_timeout()
+        poisoned = dict(honest)
+        poisoned["byz"] = [heightlog.MAX_ARRIVAL_S] * 64
+        at_poisoned = AdaptiveTimeouts(cfg, rollup=_rollup(poisoned), ledger=led)
+        assert at_poisoned.commit_timeout() == pytest.approx(baseline, rel=0.01)
+
+    def test_opt_out_config_and_env(self, monkeypatch):
+        led = _ledger_with_phases(16, propose_s=0.010)
+        rollup = _rollup({f"p{i}": [0.001] * 4 for i in range(4)})
+        cfg_off = ConsensusConfig(adaptive_timeouts=False)
+        at = AdaptiveTimeouts(cfg_off, rollup=rollup, ledger=led)
+        assert at.propose_timeout(0) == cfg_off.propose_timeout(0)
+        cfg_on = ConsensusConfig()
+        monkeypatch.setenv("TENDERMINT_TPU_ADAPTIVE_TIMEOUTS", "0")
+        at_env = AdaptiveTimeouts(cfg_on, rollup=rollup, ledger=led)
+        assert at_env.propose_timeout(0) == cfg_on.propose_timeout(0)
+
+    def test_derived_gauge_exported(self):
+        from tendermint_tpu.telemetry import REGISTRY
+
+        cfg = ConsensusConfig()
+        led = _ledger_with_phases(16, propose_s=0.010)
+        at = AdaptiveTimeouts(cfg, rollup=_rollup({}), ledger=led)
+        at.propose_timeout(0)
+        fam = REGISTRY.get("tendermint_consensus_timeout_derived_seconds")
+        vals = {labels[0]: snap for labels, snap in fam.samples()}
+        assert vals["propose"] == pytest.approx(0.030, rel=0.01)

@@ -946,6 +946,80 @@ def drive_finality(
             "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
         }
 
+    def measure_idle_leg(mutator, warm_leg, heights, label):
+        """One live-net idle run -> p50/p99 + pipeline overlap stats."""
+        with tempfile.TemporaryDirectory(prefix=f"hotpath-fin-{label}-") as h:
+            with Nemesis(
+                n_vals,
+                home=h,
+                node_factory=Nemesis.full_node_factory(config_mutator=mutator),
+            ) as net:
+                lead = net.nodes[0]
+                net.wait_height(warm_leg + heights, timeout=300)
+                recs = [
+                    r
+                    for r in lead.node.height_ledger.recent()
+                    if warm_leg < r["height"] <= warm_leg + heights
+                ]
+                gaps = [
+                    r["finality_s"]
+                    for r in recs
+                    if isinstance(r.get("finality_s"), (int, float))
+                ]
+                p50, p99 = _finality_pctls(gaps)
+                pipelined = [r for r in recs if r.get("pipelined")]
+                out = {
+                    "heights": len(recs),
+                    "p50_ms": round(p50 * 1e3, 3) if p50 is not None else None,
+                    "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+                    "pipelined_heights": len(pipelined),
+                }
+                if pipelined:
+                    out["apply_overlap_ms_mean"] = round(
+                        sum(r.get("apply_overlap_s") or 0.0 for r in pipelined)
+                        / len(pipelined)
+                        * 1e3,
+                        3,
+                    )
+                return out
+
+    def pipeline_ab(heights: int = 6) -> dict:
+        """Serial-vs-pipelined on the live net at the PRODUCTION commit
+        pacing (timeout_commit=1s, the deployment default): the serial
+        leg is the pre-pipeline configuration (strictly serial finalize
+        + the fixed timeout ladder), the pipelined leg is this PR
+        (overlapped apply + measured-latency timeouts). This is where
+        ROADMAP item 3's floors move DOWN — a healthy net stops
+        sleeping out the static commit pacing, and the apply rides
+        under the next height's voting."""
+        from tendermint_tpu.consensus.ticker import AdaptiveTimeouts
+
+        def prod(pipe):
+            def mut(cfg):
+                c = ConsensusConfig.test_config()
+                c.timeout_commit = 1000  # production pacing
+                c.skip_timeout_commit = False  # production default
+                c.pipeline_commit = pipe
+                c.adaptive_timeouts = pipe
+                c.max_block_size_txs = 256
+                cfg.consensus = c
+
+            return mut
+
+        serial = measure_idle_leg(prod(False), 2, heights, "serial")
+        # warm past the derivation gate so measured timeouts engage
+        warm_pipe = AdaptiveTimeouts.MIN_HEIGHTS + 1
+        pipelined = measure_idle_leg(prod(True), warm_pipe, heights + 2, "pipe")
+        speedup = None
+        if serial["p50_ms"] and pipelined["p50_ms"]:
+            speedup = round(serial["p50_ms"] / pipelined["p50_ms"], 3)
+        return {
+            "commit_pacing_ms": 1000,
+            "serial": serial,
+            "pipelined": pipelined,
+            "speedup_idle_p50": speedup,
+        }
+
     with tempfile.TemporaryDirectory(prefix="hotpath-finality-") as home:
         with Nemesis(
             n_vals,
@@ -1007,6 +1081,9 @@ def drive_finality(
             )
             loaded["txs_committed"] = txs
             loaded["committed_tx_per_s"] = round(txs / span, 1) if span else None
+    sys.stderr.write(
+        "driving serial-vs-pipelined A/B at production commit pacing...\n"
+    )
     return {
         "validators": n_vals,
         "consensus_config": "test (skip_timeout_commit)",
@@ -1016,6 +1093,7 @@ def drive_finality(
         "critical_path_counts": dict(
             sorted(path_counts.items(), key=lambda kv: -kv[1])
         ),
+        "pipeline": pipeline_ab(),
     }
 
 

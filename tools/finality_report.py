@@ -6,9 +6,11 @@ each node's data dir (`heights.jsonl`, `telemetry/heightlog.py`) — or
 the `heightledger-*.json` dumps written on invariant violations — into
 one per-height view (the `trace_timeline.py` merge discipline applied
 to finality): every node's commit-to-commit gap, phase decomposition,
-critical-path label, and the **laggard validator** whose vote arrived
-last, plus an aggregate summary (per-phase means, critical-path
-histogram, laggard leaderboard).
+critical-path label, pipelined-apply overlap (`ovl=` — how much of the
+ABCI apply ran under the next height's voting), and the **laggard
+validator** whose vote arrived last, plus an aggregate summary
+(per-phase means, critical-path histogram, laggard leaderboard,
+pipelined-height count + mean overlap).
 
 Usage:
   python tools/finality_report.py --ledgers node*/data/heights.jsonl
@@ -98,6 +100,8 @@ def build_report(
     path_counts: dict[str, int] = defaultdict(int)
     laggards: dict[str, int] = defaultdict(int)
     gaps: list[float] = []
+    pipelined_n = 0
+    overlap_sum = 0.0
     rows = {}
     for h in heights:
         nodes = []
@@ -116,6 +120,9 @@ def build_report(
             lag = r.get("laggard")
             if isinstance(lag, dict) and lag.get("validator"):
                 laggards[lag["validator"]] += 1
+            if r.get("pipelined"):
+                pipelined_n += 1
+                overlap_sum += r.get("apply_overlap_s") or 0.0
             nodes.append(r)
         rows[h] = nodes
     gaps.sort()
@@ -147,6 +154,10 @@ def build_report(
             "laggard_counts": dict(
                 sorted(laggards.items(), key=lambda kv: -kv[1])
             ),
+            "pipelined_heights": pipelined_n,
+            "apply_overlap_ms_mean": round(overlap_sum / pipelined_n * 1e3, 3)
+            if pipelined_n
+            else None,
         },
     }
 
@@ -185,9 +196,14 @@ def render_text(report: dict) -> str:
                 if isinstance(lag, dict)
                 else ""
             )
+            ovl_s = (
+                f"  ovl={(r.get('apply_overlap_s') or 0.0) * 1e3:.1f}ms"
+                if r.get("pipelined")
+                else ""
+            )
             lines.append(
                 f"  {r.get('node', '?'):<14} {gap_s}  [{bar}]  "
-                f"path={r.get('critical_path', '?')}{lag_s}"
+                f"path={r.get('critical_path', '?')}{ovl_s}{lag_s}"
             )
     s = report["summary"]
     lines.append("")
@@ -210,6 +226,11 @@ def render_text(report: dict) -> str:
         "laggards: "
         + (" ".join(f"{k}x{v}" for k, v in s["laggard_counts"].items()) or "-")
     )
+    if s.get("pipelined_heights"):
+        lines.append(
+            f"pipeline: {s['pipelined_heights']} pipelined records, "
+            f"apply overlap mean {s['apply_overlap_ms_mean']}ms"
+        )
     return "\n".join(lines) + "\n"
 
 

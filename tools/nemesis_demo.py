@@ -250,6 +250,97 @@ def run_byzantine_scenario(args) -> int:
     return 1 if failed else 0
 
 
+def run_pipeline_scenario(args) -> int:
+    """Cross-height pipeline chaos book (ROADMAP item 3's gate): a
+    FAULTED apply landing mid-pipeline drains at the join barrier and
+    halts its node with no speculative state persisted; a FORGED apply
+    (diverged local execution) can never fork the chain — the honest
+    +2/3 keeps committing honest headers while the forger wedges
+    itself. Restarting the faulted node proves the drain left a
+    recoverable WAL/store. No-fork + commit-agreement invariants run
+    continuously."""
+    from tendermint_tpu.state.state import load_state
+    from tendermint_tpu.testing import Nemesis
+    from tendermint_tpu.testing.nemesis import (
+        FaultedApplyApp,
+        ForgedHashApp,
+        one_bad_app_factory,
+    )
+
+    t_all = time.time()
+    verdicts: list[tuple[str, str, str]] = []
+
+    def wait_fatal(node, timeout=30.0):
+        deadline = time.time() + timeout
+        while node.cs.fatal_error is None and time.time() < deadline:
+            time.sleep(0.1)
+        return node.cs.fatal_error
+
+    print("[1/2] faulted apply mid-pipeline: node 3's ABCI commit raises at height 4 ...")
+    with Nemesis(
+        args.nodes,
+        home=tempfile.mkdtemp(prefix="nemesis-pipe-"),
+        node_factory=Nemesis.full_node_factory(
+            app_factory=one_bad_app_factory(
+                3, FaultedApplyApp, args.nodes, fail_from_height=4
+            )
+        ),
+    ) as net:
+        honest = list(range(args.nodes - 1))
+        net.wait_height(6, nodes=honest, timeout=args.timeout)
+        err = wait_fatal(net.nodes[3])
+        persisted = load_state(net.nodes[3].node.state_db).last_block_height
+        net.check_no_fork()
+        ok = err is not None and persisted == 3
+        verdicts.append(
+            (
+                "faulted apply",
+                "PASS" if ok else "FAIL",
+                f"halted={err is not None} persisted_height={persisted} "
+                f"(speculative height 4 never landed), honest chain at "
+                f"{max(net.heights())}, no fork",
+            )
+        )
+
+    print("[2/2] forged apply: node 3's app returns a forged app hash from height 3 ...")
+    with Nemesis(
+        args.nodes,
+        home=tempfile.mkdtemp(prefix="nemesis-forge-"),
+        node_factory=Nemesis.full_node_factory(
+            app_factory=one_bad_app_factory(
+                3, ForgedHashApp, args.nodes, fail_from_height=3
+            )
+        ),
+    ) as net:
+        honest = list(range(args.nodes - 1))
+        net.wait_height(6, nodes=honest, timeout=args.timeout)
+        err = wait_fatal(net.nodes[3])
+        forged = b"\xde\xad\xbe\xef" * 5
+        clean = all(
+            net.nodes[0].store.load_block_meta(h).header.app_hash != forged
+            for h in range(4, net.nodes[0].store.height + 1)
+        )
+        net.check_no_fork()
+        ok = err is not None and clean
+        verdicts.append(
+            (
+                "forged apply",
+                "PASS" if ok else "FAIL",
+                f"forger halted={err is not None}, no committed header "
+                f"carries the forged hash={clean}, honest chain at "
+                f"{max(net.heights())}, no fork",
+            )
+        )
+
+    print(f"\npipeline chaos book done in {time.time() - t_all:.1f}s:")
+    width = max(len(s) for s, _, _ in verdicts)
+    failed = 0
+    for scenario, verdict, detail in verdicts:
+        print(f"  {scenario:<{width}}  {verdict}  {detail}")
+        failed += verdict != "PASS"
+    return 1 if failed else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
@@ -267,6 +358,12 @@ def main() -> int:
         help="run the Byzantine adversary book (equivocator -> evidence "
         "committed; flooder -> banned, breaker closed; proposer "
         "equivocation; frame fuzzing) instead",
+    )
+    ap.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="run the cross-height pipeline chaos book (faulted apply "
+        "drains at the join barrier; forged apply cannot fork) instead",
     )
     ap.add_argument("--rate", type=float, default=150.0, help="ingress tx/s")
     ap.add_argument("--txs", type=int, default=1000, help="ingress tx cap")
@@ -286,6 +383,12 @@ def main() -> int:
 
         setup_logging("byzantine:info,evidence:warning,nemesis:info,*:error")
         return run_byzantine_scenario(args)
+
+    if args.pipeline:
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging("nemesis:info,*:error")
+        return run_pipeline_scenario(args)
 
     from tendermint_tpu.services.resilient import ResilientVerifier
     from tendermint_tpu.services.verifier import HostBatchVerifier
