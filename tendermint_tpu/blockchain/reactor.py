@@ -121,6 +121,7 @@ class BlockchainReactor(Reactor):
         hasher=None,
         deferred: bool = False,
         pipeline_depth: int | None = None,
+        follow: bool = False,
     ) -> None:
         super().__init__()
         self.state = state
@@ -128,6 +129,10 @@ class BlockchainReactor(Reactor):
         self.app_conn = app_conn
         self.fast_sync = fast_sync
         self.on_caught_up = on_caught_up
+        # follow mode (read replicas): never exit fast-sync — keep
+        # tailing the chain as peers advance instead of handing off to
+        # consensus. `is_caught_up` then just means "at the tip".
+        self.follow = follow
         self.verifier = verifier
         self.tx_indexer = tx_indexer
         self.hasher = hasher
@@ -249,12 +254,19 @@ class BlockchainReactor(Reactor):
 
                 logging.getLogger(__name__).exception("fast-sync step failed")
                 time.sleep(0.5)
-            if self.pool.is_caught_up():
+            if not self.follow and self.pool.is_caught_up():
                 self.fast_sync = False
                 if self.on_caught_up is not None:
                     self.on_caught_up(self.state)
                 return
             time.sleep(_SYNC_TICK_S)
+
+    def tip_lag(self) -> int:
+        """Heights between the best-known peer tip and our store head
+        (0 at the tip). Follow-mode replicas stay in fast-sync forever,
+        so health derives their readiness from this instead of the
+        `fast_sync` flag."""
+        return max(0, self.pool.max_peer_height() - self.store.height)
 
     def _queue(self):
         """The reactor-owned dispatch queue (one per fast-syncing node,

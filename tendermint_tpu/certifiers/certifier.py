@@ -218,7 +218,26 @@ class InquiringCertifier:
         self.trusted.store_commit(fc)
 
     def update_to_height(self, height: int) -> None:
-        """Move the trusted valset to the one in force at `height`."""
+        """Move the trusted valset to the one in force at `height`.
+
+        This is the O(heights) SEQUENTIAL walk — kept as the reference
+        baseline (and the `mode="sequential"` leg of
+        `tendermint_lightclient_walk_seconds`); the production read
+        path is `lightclient/bisect.BisectingCertifier`, which replaces
+        it with O(log n) batched skipping verification."""
+        import time as _time
+
+        from tendermint_tpu.telemetry import metrics as _metrics
+
+        t0 = _time.perf_counter()
+        try:
+            self._update_to_height(height)
+        finally:
+            _metrics.LIGHTCLIENT_WALK_SECONDS.labels(mode="sequential").observe(
+                _time.perf_counter() - t0
+            )
+
+    def _update_to_height(self, height: int) -> None:
         # restart from the closest trusted commit at/below the target
         tfc = self.trusted.get_by_height(height)
         if tfc is not None and tfc.height() > self.cert.last_height:

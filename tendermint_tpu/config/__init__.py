@@ -96,6 +96,29 @@ class StateSyncConfig:
 
 
 @dataclass
+class ReplicaConfig:
+    """Stateless read-replica mode (`tendermint_tpu/lightclient/`).
+
+    `enable` turns the node into a replica: it bootstraps like any
+    fresh node (statesync when `[statesync] enable` is set, else
+    fast-sync from genesis), NEVER joins consensus (no ConsensusState,
+    no validator key use), follows the chain via a follow-mode
+    fast-sync tail plus the 0x68 FullCommit subscription, and serves
+    light-client queries (FullCommits, commits, merkle-proof-carrying
+    reads). Replicas certify every pushed FullCommit through a
+    bisecting light-client pin before serving it — a forged push is
+    scored + turned into evidence, never trusted.
+
+    `fullcommit_cache_size` bounds the certified-commit cache (0 =
+    unbounded); `serve_lightclient` keeps the serving half on for
+    non-replica nodes too (every full node serves 0x68 by default)."""
+
+    enable: bool = False
+    fullcommit_cache_size: int = 2048
+    serve_lightclient: bool = True
+
+
+@dataclass
 class MempoolConfig:
     """Reference `config/config.go:267-288` + the ingress pipeline
     (`mempool/ingress.py`): `lanes` shards the pool into tx-hash
@@ -126,6 +149,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    replica: ReplicaConfig = field(default_factory=ReplicaConfig)
 
     # -- derived paths -----------------------------------------------------
 
@@ -177,7 +201,7 @@ class Config:
         return cfg
 
 
-_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "statesync")
+_SECTIONS = ("base", "rpc", "p2p", "mempool", "consensus", "statesync", "replica")
 
 
 def write_config(cfg: Config) -> str:

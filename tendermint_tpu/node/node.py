@@ -173,6 +173,14 @@ class Node:
         self.tx_indexer = KVTxIndexer(_db("txindex"))
         self.event_switch = ev.EventSwitch()
 
+        # replica mode (tendermint_tpu/lightclient/): never join
+        # consensus, follow the chain via fast-sync tail + FullCommit
+        # subscription, serve light-client reads. The validator key is
+        # deliberately unused — a replica must not be able to sign.
+        self.is_replica = bool(cfg.replica.enable)
+        if self.is_replica:
+            self.priv_validator = None
+
         # fast-sync only when peers could be ahead AND we are not the
         # solo validator (reference node.go:174-205)
         solo = (
@@ -181,7 +189,7 @@ class Node:
             and self.state.validators.validators[0].address
             == self.priv_validator.address
         )
-        fast_sync = cfg.base.fast_sync and not solo
+        fast_sync = cfg.base.fast_sync and (self.is_replica or not solo)
         # state-sync bootstrap: only a FRESH node (nothing committed
         # locally) may skip history, and it needs fast-sync for the tail
         state_sync = (
@@ -211,24 +219,49 @@ class Node:
             verifier=verifier,
             chain_id=self.genesis.chain_id,
         )
-        self.consensus = ConsensusState(
-            config=cfg.consensus,
-            state=self.state,
-            app_conn=self.app_conns.consensus,
-            block_store=self.block_store,
-            mempool=self.mempool,
-            priv_validator=self.priv_validator,
-            event_switch=self.event_switch,
-            wal_path=cfg.wal_path(),
-            ticker=TimeoutTicker(),
-            verifier=verifier,
-            tx_indexer=self.tx_indexer,
-            hasher=hasher,
-            evidence_pool=self.evidence_pool,
-            heightlog=self.height_ledger,
-        )
+        if self.is_replica:
+            # replicas run NO consensus machinery at all: no round
+            # state, no WAL, no vote signing — the follow-mode
+            # fast-sync below is the only way their state advances
+            self.consensus = None
+            self.consensus_reactor = None
+            # the evidence pool still needs its height clock + valset
+            # resolver (forged-FullCommit evidence is admitted here);
+            # best-effort like consensus's resolver — an unknown height
+            # falls back to the live set, it never raises into the
+            # gossip path (that would debit an honest relaying peer)
+            self.evidence_pool.best_height_fn = lambda: self.block_store.height
+
+            def _replica_evidence_valset(h: int):
+                from tendermint_tpu.types.errors import ValidationError
+
+                try:
+                    return self.current_state.load_validators(h)
+                except ValidationError:
+                    return self.current_state.validators
+
+            self.evidence_pool.val_set_fn = _replica_evidence_valset
+        else:
+            self.consensus = ConsensusState(
+                config=cfg.consensus,
+                state=self.state,
+                app_conn=self.app_conns.consensus,
+                block_store=self.block_store,
+                mempool=self.mempool,
+                priv_validator=self.priv_validator,
+                event_switch=self.event_switch,
+                wal_path=cfg.wal_path(),
+                ticker=TimeoutTicker(),
+                verifier=verifier,
+                tx_indexer=self.tx_indexer,
+                hasher=hasher,
+                evidence_pool=self.evidence_pool,
+                heightlog=self.height_ledger,
+            )
+            self.consensus_reactor = ConsensusReactor(
+                self.consensus, fast_sync=fast_sync
+            )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
-        self.consensus_reactor = ConsensusReactor(self.consensus, fast_sync=fast_sync)
         self.blockchain_reactor = BlockchainReactor(
             state=self.state,
             store=self.block_store,
@@ -239,6 +272,7 @@ class Node:
             tx_indexer=self.tx_indexer,
             hasher=hasher,
             deferred=state_sync,
+            follow=self.is_replica,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool, broadcast=cfg.mempool.broadcast
@@ -288,6 +322,58 @@ class Node:
                 "statesync", ev.EVENT_NEW_BLOCK, lambda _data: self._maybe_snapshot()
             )
 
+        # light-client serving layer (ROADMAP item 1): every node serves
+        # certified FullCommits on the 0x68 channel; replicas
+        # additionally subscribe to the push stream and certify it
+        # through a bisecting light-client pin before caching/serving —
+        # the cache is positives-only, so a forged FullCommit can never
+        # pin trust.
+        from tendermint_tpu.db.fullcommit import FullCommitStore
+        from tendermint_tpu.lightclient import (
+            BisectingCertifier,
+            CertifiedCommitCache,
+            LightClientReactor,
+            PeerProvider,
+        )
+
+        self.fullcommit_store = FullCommitStore(_db("fullcommits"))
+        self.fullcommit_cache = CertifiedCommitCache(
+            cfg.replica.fullcommit_cache_size, store=self.fullcommit_store
+        )
+        self.lightclient_reactor = LightClientReactor(
+            chain_id=self.genesis.chain_id,
+            block_store=self.block_store,
+            state=self.state,
+            cache=self.fullcommit_cache,
+            subscribe=self.is_replica,
+            evidence_pool=self.evidence_pool,
+            verifier=verifier,
+        )
+        self.lightclient_certifier = None
+        if self.is_replica:
+            # subjective init: the statesync trust pin when configured,
+            # else the genesis valset (fine for young chains — same
+            # fallback the statesync TrustAnchor documents)
+            self.lightclient_certifier = BisectingCertifier(
+                self.genesis.chain_id,
+                validators=self.genesis.validator_set(),
+                height=0,
+                trusted=self.fullcommit_cache,
+                source=PeerProvider(self.lightclient_reactor),
+                verifier=verifier,
+                trust_period_ns=int(cfg.statesync.trust_period_s * 1e9),
+            )
+            self.lightclient_reactor.certifier = self.lightclient_certifier
+        if not self.is_replica:
+            # push freshly committed FullCommits to subscribed replicas
+            # (runs on the consensus thread right after the commit is
+            # stored; no-op without subscribers)
+            self.event_switch.add_listener(
+                "lightclient",
+                ev.EVENT_NEW_BLOCK,
+                lambda data: self._announce_fullcommit(data),
+            )
+
         self.switch = Switch(
             NodeInfo(
                 node_id=self.node_id,
@@ -320,10 +406,13 @@ class Node:
 
             self.switch.peer_filter = _abci_peer_filter
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
-        self.switch.add_reactor("consensus", self.consensus_reactor)
+        if self.consensus_reactor is not None:
+            self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("mempool", self.mempool_reactor)
         self.switch.add_reactor("evidence", self.evidence_reactor)
         self.switch.add_reactor("statesync", self.statesync_reactor)
+        if cfg.replica.serve_lightclient or self.is_replica:
+            self.switch.add_reactor("lightclient", self.lightclient_reactor)
         self.pex_reactor = None
         if cfg.p2p.pex:
             from tendermint_tpu.p2p.addrbook import AddrBook
@@ -361,8 +450,25 @@ class Node:
 
     def _on_caught_up(self, state) -> None:
         """Fast-sync finished: start consensus (reference
-        `SwitchToConsensus`)."""
-        self.consensus_reactor.switch_to_consensus(state)
+        `SwitchToConsensus`). Replicas never get here — their follow-
+        mode fast-sync has no caught-up exit."""
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(state)
+
+    def _announce_fullcommit(self, data) -> None:
+        """EVENT_NEW_BLOCK listener: push the just-committed height's
+        FullCommit to 0x68 subscribers (cheap without subscribers)."""
+        try:
+            height = (
+                data.block.header.height
+                if data is not None and getattr(data, "block", None) is not None
+                else self.block_store.height
+            )
+            self.lightclient_reactor.announce_height(height)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).exception("fullcommit announce failed")
 
     def _on_state_synced(self, state) -> None:
         """State sync ended: with a restored state, adopt it and

@@ -46,6 +46,10 @@ MISBEHAVIOR_WEIGHTS: dict[str, int] = {
     # a block failing commit verification cannot be served honestly
     # (it would need 2/3 forged signatures): instant ban
     "forged_block": 100,
+    # a FullCommit failing light-client certification cannot be served
+    # honestly either (forged signatures or an impossible quorum): a
+    # replica/peer caught serving one is lying about the chain
+    "forged_fullcommit": 100,
     "bad_evidence": 50,  # evidence proof with forged signatures
     "flood": 10,  # per-round state-growth abuse (maj23 claim flood)
 }

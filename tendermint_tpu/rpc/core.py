@@ -12,6 +12,7 @@ import queue
 import time
 
 from tendermint_tpu.rpc.server import RPCError
+from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.types import events as ev
 from tendermint_tpu.types.tx import tx_hash
 
@@ -183,11 +184,32 @@ def make_routes(node) -> dict:
         }
         if meta is not None:
             out["header"] = _header_json(meta.header)
+        _metrics.REPLICA_PROOFS_SERVED.labels(kind="commit").inc()
         return out
+
+    def full_commit(height: int = 0) -> dict:
+        """One light-client proof unit — header + commit + valset at a
+        height (0 = tip) — served from the certified cache / local
+        stores through the 0x68 reactor's exact->floor lookup. The
+        `full_commit` hex decodes via `FullCommit.decode`; external
+        light clients feed it straight into a certifier walk without
+        the three-round-trip commit+validators+header dance."""
+        reactor = getattr(node, "lightclient_reactor", None)
+        fc = reactor.serve_commit(int(height)) if reactor is not None else None
+        if fc is None:
+            raise RPCError(-32000, f"no full commit at height {height}")
+        _metrics.REPLICA_PROOFS_SERVED.labels(kind="full_commit").inc()
+        return {
+            "height": fc.height(),
+            "header": _header_json(fc.header),
+            "canonical": True,
+            "full_commit": fc.encode().hex(),
+        }
 
     def validators(height: int | None = None) -> dict:
         h = int(height) if height is not None else node.current_state.last_block_height + 1
         vs = node.current_state.load_validators(h)
+        _metrics.REPLICA_PROOFS_SERVED.labels(kind="validators").inc()
         return {
             "block_height": h,
             "validators": [
@@ -315,6 +337,8 @@ def make_routes(node) -> dict:
         res = node.app_conns.query.query_sync(
             path, bytes.fromhex(data) if data else b"", int(height), bool(prove)
         )
+        if prove:
+            _metrics.REPLICA_PROOFS_SERVED.labels(kind="abci_query").inc()
         return {
             "code": res.code,
             "value": res.value.hex(),
@@ -425,6 +449,7 @@ def make_routes(node) -> dict:
             if blk is None:
                 raise RPCError(-32000, f"block {tr.height} not in store")
             tx_proof = blk.data.txs.proof(tr.index)
+            _metrics.REPLICA_PROOFS_SERVED.labels(kind="tx").inc()
             out["proof"] = {
                 "root_hash": tx_proof.root_hash.hex(),
                 "data": tx_proof.data.hex(),
@@ -575,6 +600,7 @@ def make_routes(node) -> dict:
         "block": block,
         "blockchain": blockchain,
         "commit": commit,
+        "full_commit": full_commit,
         "validators": validators,
         "dump_consensus_state": dump_consensus_state,
         "dump_telemetry": dump_telemetry,

@@ -7,9 +7,12 @@ certifier walk (PAPERS.md: EdDSA amortization in committee consensus;
 the certifier re-walks overlapping valsets) — and each of the four
 independent consumers (consensus vote drain, fast-sync, statesync trust
 anchoring, RPC/light-client certifiers — and, since the ingress
-pipeline, mempool CheckTx windows as the fifth, `consumer="mempool"`)
-pays the fixed ~86 ms device launch (docs/PLATFORM_NOTES.md) on its
-own small, partially-duplicate batch. Two layers remove both costs:
+pipeline, mempool CheckTx windows as the fifth, `consumer="mempool"`,
+and, since the light-client serving layer, bisection-walk rounds as
+the sixth, `consumer="lightclient"` — one batched launch per bisection
+round, `lightclient/bisect.py`) pays the fixed ~86 ms device launch
+(docs/PLATFORM_NOTES.md) on its own small, partially-duplicate batch.
+Two layers remove both costs:
 
 * `VerifiedSigCache` — a sharded, thread-safe LRU of PROVEN triples,
   keyed by SHA-256 over the length-prefixed `pubkey‖msg‖sig` (prefixes

@@ -52,6 +52,16 @@ Knobs (env):
                                      exhaustion, seconds (30; 0 off)
   TENDERMINT_TPU_HEALTH_MIN_PEERS    peer floor before degraded (1)
   TENDERMINT_TPU_HEALTH_MAX_LAG_S    commit-age ceiling, seconds (60)
+  TENDERMINT_TPU_HEALTH_MAX_TIP_LAG  heights a follow-mode replica may
+                                     trail the peer tip and stay ready (8)
+
+The **serving** section (light-client layer, lightclient/reactor.py)
+appears on nodes running the 0x68 serving reactor: FullCommit-cache
+warmth, proof-serving lag behind the chain tip, and subscription
+liveness — reported, never folded, with one deliberate exception: a
+follow-mode REPLICA's readiness comes from the tip-lag rule above (a
+replica serving stale heights must not take read traffic, and that IS
+a routing decision).
 """
 
 from __future__ import annotations
@@ -199,6 +209,23 @@ def _pipeline_section(consensus) -> dict:
     return out
 
 
+def _serving_section(node) -> dict | None:
+    """Light-client serving view (lightclient/reactor.py): cache
+    warmth, proof-serving lag, subscription liveness. REPORTED under
+    the same never-folded discipline as the SLO/device/pipeline
+    sections — replica readiness is the sync check's tip-lag rule, not
+    this. None on nodes without the serving layer (harness stubs)."""
+    reactor = getattr(node, "lightclient_reactor", None)
+    if reactor is None or not hasattr(reactor, "serving_stats"):
+        return None
+    try:
+        out = reactor.serving_stats()
+    except Exception:
+        return None
+    out["replica"] = bool(getattr(node, "is_replica", False))
+    return out
+
+
 def build_health(node, ledger=None) -> dict:
     """The health snapshot for one composed node (`node.Node` or
     anything duck-typed close enough — every read is getattr-tolerant,
@@ -217,7 +244,8 @@ def build_health(node, ledger=None) -> dict:
 
     # -- readiness ---------------------------------------------------------
     bc = getattr(node, "blockchain_reactor", None)
-    catching_up = bool(getattr(bc, "fast_sync", False))
+    follow = bool(getattr(bc, "follow", False))
+    catching_up = bool(getattr(bc, "fast_sync", False)) and not follow
     ss = getattr(node, "statesync_reactor", None)
     state_syncing = bool(getattr(ss, "sync", False)) and (
         getattr(ss, "restored_state", None) is None
@@ -234,6 +262,24 @@ def build_health(node, ledger=None) -> dict:
             "state_sync": state_syncing,
         },
     }
+    if follow:
+        # follow-mode replicas stay in fast-sync FOREVER, so readiness
+        # is distance from the best-known peer tip, not the flag: a
+        # replica serving heights far behind the chain must not take
+        # read traffic (TENDERMINT_TPU_HEALTH_MAX_TIP_LAG heights).
+        max_tip_lag = int(_env_float("TENDERMINT_TPU_HEALTH_MAX_TIP_LAG", 8))
+        try:
+            tip_lag = int(bc.tip_lag())
+        except Exception:
+            tip_lag = 0
+        checks["sync"] = {
+            "ok": not state_syncing and tip_lag <= max_tip_lag,
+            "fast_sync": False,
+            "state_sync": state_syncing,
+            "follow": True,
+            "tip_lag": tip_lag,
+            "max_tip_lag": max_tip_lag,
+        }
 
     # -- degradation -------------------------------------------------------
     checks["breakers"] = _breaker_check(node)
@@ -287,7 +333,7 @@ def build_health(node, ledger=None) -> dict:
     )
     status = "not_ready" if not_ready else ("degraded" if degraded else "ok")
     store = getattr(node, "block_store", None)
-    return {
+    out = {
         "status": status,
         "ready": not not_ready,
         "node_id": getattr(node, "node_id", ""),
@@ -302,3 +348,11 @@ def build_health(node, ledger=None) -> dict:
         # pipeline is slower finality, which the SLO section owns)
         "pipeline": _pipeline_section(consensus),
     }
+    # light-client serving layer (reported, never folded — with ONE
+    # exception: the follow-mode tip-lag check above, which IS the
+    # replica's readiness): FullCommit-cache warmth, proof-serving lag
+    # behind the chain tip, subscription liveness.
+    serving = _serving_section(node)
+    if serving is not None:
+        out["serving"] = serving
+    return out

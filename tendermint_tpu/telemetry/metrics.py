@@ -297,8 +297,8 @@ DISPATCH_OVERLAP = Histogram(
 # -- verify coalescer + dedup cache (services/batcher.py) ---------------------
 #
 # `consumer` labels are the verify-request owners ("consensus",
-# "fastsync", "statesync", "rpc", "mempool", "default") — a fixed
-# small set.
+# "fastsync", "statesync", "rpc", "mempool", "lightclient",
+# "default") — a fixed small set.
 
 VERIFY_CACHE_HITS = Counter(
     "tendermint_verify_cache_hits_total",
@@ -373,6 +373,7 @@ SPAN_CATALOG = frozenset(
         "consensus.precommit",
         "consensus.commit",
         "consensus.height",
+        "lightclient.walk",
         "mempool.admission",
         "mempool.window",
         "p2p.hop",
@@ -524,6 +525,54 @@ STATESYNC_RESTORES = Counter(
 for _result in ("ok", "corrupt", "timeout"):
     STATESYNC_CHUNKS.labels(result=_result).inc(0)
 
+# -- light-client serving layer (tendermint_tpu/lightclient/) -----------------
+#
+# `result` is the fixed walk-outcome vocabulary: ok (trust advanced to
+# the target), too_much_change (bisection bottomed out — the valset
+# churned faster than the source's commit density can bridge), forged
+# (a candidate carried an invalid signature / impossible quorum — a
+# provider offense, never a bisection trigger). `mode` distinguishes
+# the legacy header-by-header walk (sequential — the
+# InquiringCertifier baseline) from the skipping walk (bisect).
+# `kind` on the proofs-served counter is the fixed query taxonomy
+# (full_commit / commit / validators / tx / abci_query) — never
+# heights or peer ids.
+
+LIGHTCLIENT_BISECTIONS = Counter(
+    "tendermint_lightclient_bisections_total",
+    "Skipping-verification walks by outcome (ok / too_much_change / forged)",
+    labelnames=("result",),
+)
+LIGHTCLIENT_WALK_SECONDS = Histogram(
+    "tendermint_lightclient_walk_seconds",
+    "Wall time one certifier walk took to move trust to the target "
+    "height (sequential = header-by-header InquiringCertifier, "
+    "bisect = batched skipping verification)",
+    labelnames=("mode",),
+    buckets=LATENCY_BUCKETS,
+)
+LIGHTCLIENT_CACHE_HITS = Counter(
+    "tendermint_lightclient_cache_hits_total",
+    "FullCommit lookups answered from the certified-commit cache",
+)
+LIGHTCLIENT_CACHE_MISSES = Counter(
+    "tendermint_lightclient_cache_misses_total",
+    "FullCommit lookups that missed the certified-commit cache",
+)
+REPLICA_PROOFS_SERVED = Counter(
+    "tendermint_replica_proofs_served_total",
+    "Light-client queries answered by this node's serving layer, by "
+    "proof kind (p2p FullCommit channel + proof-carrying RPC routes)",
+    labelnames=("kind",),
+)
+
+for _result in ("ok", "too_much_change", "forged"):
+    LIGHTCLIENT_BISECTIONS.labels(result=_result).inc(0)
+for _mode in ("sequential", "bisect"):
+    LIGHTCLIENT_WALK_SECONDS.labels(mode=_mode)
+for _kind in ("full_commit", "commit", "validators", "tx", "abci_query"):
+    REPLICA_PROOFS_SERVED.labels(kind=_kind).inc(0)
+
 # -- p2p ----------------------------------------------------------------------
 
 P2P_SENT_BYTES = Counter(
@@ -562,7 +611,8 @@ P2P_SEND_WAIT = Histogram(
 )
 # Adversarial-input defense (p2p/score.py + Switch.report_misbehavior):
 # `kind` is the fixed offense taxonomy (bad_frame/oversize_frame/
-# bad_msg/bad_sig/bad_vote/forged_block/bad_evidence/flood) — never
+# bad_msg/bad_sig/bad_vote/forged_block/forged_fullcommit/
+# bad_evidence/flood) — never
 # peer ids (per-peer scores live in the scorer's diagnostics snapshot).
 PEER_MISBEHAVIOR = Counter(
     "tendermint_p2p_peer_misbehavior_total",
@@ -581,6 +631,7 @@ for _kind in (
     "bad_sig",
     "bad_vote",
     "forged_block",
+    "forged_fullcommit",
     "bad_evidence",
     "flood",
 ):

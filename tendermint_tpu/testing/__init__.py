@@ -9,20 +9,24 @@ from tendermint_tpu.testing.nemesis import (
 from tendermint_tpu.testing.byzantine import (
     ConflictingProposer,
     Equivocator,
+    ForgedCommitPusher,
     FrameFuzzer,
     GarbageSigFlooder,
     LyingFastSyncPeer,
+    forge_fullcommit,
     wait_evidence_committed,
 )
 
 __all__ = [
     "ConflictingProposer",
     "Equivocator",
+    "ForgedCommitPusher",
     "FrameFuzzer",
     "GarbageSigFlooder",
     "InvariantViolation",
     "LyingFastSyncPeer",
     "Nemesis",
     "NemesisNode",
+    "forge_fullcommit",
     "wait_evidence_committed",
 ]

@@ -621,3 +621,84 @@ class FrameFuzzer:
                 self._endpoint.close()
             except Exception:
                 pass
+
+
+# -- the forged-FullCommit server ---------------------------------------------
+
+
+def forge_fullcommit(honest_fc, compromised_priv, chain_id: str):
+    """A forged FullCommit at an already-committed height: a forged
+    header (wrong app_hash) carrying ONE genuine precommit — the
+    compromised validator double-signing the forged block — and no
+    other signatures. Certification must reject it (a single validator
+    can never be its own +2/3 quorum), and the genuine double-sign is
+    exactly the slashable proof `extract_double_sign_evidence` mines
+    out of the rejection (the PR 9 attribution pipeline on the read
+    path)."""
+    from dataclasses import replace as _replace
+
+    from tendermint_tpu.certifiers.certifier import FullCommit
+    from tendermint_tpu.types.block import Commit
+
+    forged_header = _replace(honest_fc.header, app_hash=b"\xde\xad\xbe\xef" * 5)
+    forged_bid = BlockID(
+        forged_header.hash(),
+        PartSetHeader(total=1, hash=forged_header.hash()[:20]),
+    )
+    vals = honest_fc.validators
+    idx, _val = vals.get_by_address(compromised_priv.address)
+    if idx < 0:
+        raise ValueError("compromised validator not in the honest valset")
+    round_ = honest_fc.commit.round()
+    honest_pc = honest_fc.commit.precommits[idx]
+    vote = Vote(
+        validator_address=compromised_priv.address,
+        validator_index=idx,
+        height=honest_fc.height(),
+        round=round_,
+        timestamp=honest_pc.timestamp + 1 if honest_pc is not None else 1,
+        type=VOTE_TYPE_PRECOMMIT,
+        block_id=forged_bid,
+    )
+    sig = compromised_priv._signer.sign(vote.sign_bytes(chain_id))
+    precommits: list = [None] * len(vals.validators)
+    precommits[idx] = vote.with_signature(sig)
+    return FullCommit(
+        header=forged_header,
+        commit=Commit(block_id=forged_bid, precommits=precommits),
+        validators=vals,
+    )
+
+
+class ForgedCommitPusher:
+    """A malicious peer pushing forged FullCommits at a subscribing
+    victim on the light-client channel (0x68) — the compromised-replica
+    attack. The victim's push certifier must reject the forgery
+    (`forged_fullcommit` debit -> instant ban at weight 100) AND route
+    the embedded genuine double-sign into its evidence pool, from where
+    0x38 gossip carries it to the validators for commitment."""
+
+    def __init__(self, victim_node, forged_fc) -> None:
+        from tendermint_tpu.lightclient.reactor import (
+            LIGHTCLIENT_CHANNEL,
+            _enc_fc_announce,
+        )
+
+        self.forged_fc = forged_fc
+        self._chan = LIGHTCLIENT_CHANNEL
+        self._frame = _enc_fc_announce(forged_fc)
+        self.victim_switch = victim_node.switch
+        self.switch, self._sink = make_attacker_switch(
+            victim_node.genesis.chain_id, [LIGHTCLIENT_CHANNEL], name="forger"
+        )
+        self.attacker_id = self.switch.node_info.node_id
+        _pa, self._peer = connect_switches(self.victim_switch, self.switch)
+
+    def push(self) -> None:
+        self._peer.try_send(self._chan, self._frame)
+
+    def banned(self) -> bool:
+        return self.victim_switch.scorer.is_banned(self.attacker_id)
+
+    def stop(self) -> None:
+        self.switch.stop()

@@ -81,6 +81,9 @@ RANKS: dict[str, int] = {
     # dispatch/verify call, but snapshot() is served under RPC handlers
     # that may hold nothing, so it slots below the verify spine.
     "telemetry.profiler": 62,
+    # light-client certified-commit cache: leaf shard/index locks (seq =
+    # shard index; index lock = seq SHARDS), held over map surgery only
+    "lightclient.cache": 63,
     # verify spine
     "dispatch.handle": 64,  # VerifyHandle/ChainedHandle._lock
     "batcher.shard": 68,  # VerifiedSigCache shard locks (seq = shard index)
@@ -88,6 +91,9 @@ RANKS: dict[str, int] = {
     "dispatch.worker": 76,  # DispatchQueue._thread_lock
     "dispatch.state": 80,  # DispatchQueue._state_lock
     "dispatch.global": 84,  # default_dispatch_queue singleton lock
+    # light-client reactor bookkeeping (subscribers / request waits):
+    # leaf — released before any send, certify, or evidence admission
+    "lightclient.reactor": 86,
     # p2p locks are leaves: held only over dict/counter surgery, never
     # across reactor callbacks or sends.
     "p2p.switch": 88,  # Switch._mtx

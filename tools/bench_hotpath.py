@@ -616,6 +616,214 @@ def drive_device_efficiency(heights: int, n_vals: int, launch_ms: float) -> dict
     }
 
 
+def _build_fullcommit_chain(heights: int, n_vals: int, rotate_every: int):
+    """FullCommits for heights 1..N with one validator replaced every
+    `rotate_every` heights (sliding window over deterministic keys), so
+    a long jump's old-set overlap decays linearly — the read-path walk
+    benches need BOTH regimes: jumps the 2/3 dynamic rule rejects and
+    the 1/3 skip rule still accepts."""
+    from tendermint_tpu.certifiers.certifier import FullCommit
+    from tendermint_tpu.certifiers.provider import MemProvider
+    from tendermint_tpu.crypto import PrivKey
+    from tendermint_tpu.types import (
+        VOTE_TYPE_PRECOMMIT,
+        BlockID,
+        PartSetHeader,
+        PrivValidator,
+        Validator,
+        ValidatorSet,
+        Vote,
+        VoteSet,
+    )
+    from tendermint_tpu.types.block import Header
+
+    chain_id = "reads-bench"
+    privs_by_id: dict[int, object] = {}
+
+    def priv(i: int):
+        if i not in privs_by_id:
+            privs_by_id[i] = PrivValidator(PrivKey(i.to_bytes(32, "little")))
+        return privs_by_id[i]
+
+    source = MemProvider()
+    fcs = {}
+    for h in range(1, heights + 1):
+        rot = (h - 1) // max(1, rotate_every)
+        privs = [priv(1 + rot + k) for k in range(n_vals)]
+        vs = ValidatorSet(
+            [
+                Validator(address=p.address, pub_key=p.pub_key, voting_power=10)
+                for p in privs
+            ]
+        )
+        header = Header(
+            chain_id=chain_id,
+            height=h,
+            time=h * 1_000_000_000,
+            num_txs=0,
+            last_block_id=BlockID.zero(),
+            validators_hash=vs.hash(),
+            app_hash=b"app",
+        )
+        block_id = BlockID(
+            header.hash(), PartSetHeader(total=1, hash=header.hash()[:20])
+        )
+        by_addr = {p.address: p for p in privs}
+        vote_set = VoteSet(chain_id, h, 0, VOTE_TYPE_PRECOMMIT, vs)
+        for idx, val in enumerate(vs.validators):
+            p = by_addr[val.address]
+            vote = Vote(
+                validator_address=p.address,
+                validator_index=idx,
+                height=h,
+                round=0,
+                timestamp=h,
+                type=VOTE_TYPE_PRECOMMIT,
+                block_id=block_id,
+            )
+            vote_set.add_vote(p.sign_vote(chain_id, vote))
+        fc = FullCommit(
+            header=header, commit=vote_set.make_commit(), validators=vs
+        )
+        source.store_commit(fc)
+        fcs[h] = fc
+    return chain_id, source, fcs
+
+
+class _CountingVerifier:
+    """Counts launches (submissions) + verifies (triples) flowing
+    through an inner consumer-tagged verifier — walk-cost attribution
+    for the reads bench."""
+
+    accepts_consumer = True
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.verifies = 0
+        self.launches = 0
+
+    def reset(self):
+        self.verifies = 0
+        self.launches = 0
+
+    def verify_batch(self, triples):
+        self.verifies += len(triples)
+        self.launches += 1
+        return self.inner.verify_batch(triples)
+
+    def verify_batch_async(self, triples, queue=None, consumer: str = "default"):
+        self.verifies += len(triples)
+        self.launches += 1
+        return self.inner.verify_batch_async(triples, consumer=consumer)
+
+
+def drive_reads(
+    heights: int, n_vals: int, rotate_every: int, launch_ms: float
+) -> dict:
+    """The read path A/B (ROADMAP item 1): a fresh light client
+    verifying to the chain tip through the sequential
+    `InquiringCertifier` walk vs the batched-bisection
+    `BisectingCertifier`, both over the coalescing stack with the
+    emulated per-launch cost — plus the serving half (certified
+    FullCommit lookups + encodes per second). Dedup cache OFF so every
+    walk pays its honest verification cost (a new client shares no
+    proven triples)."""
+    from tendermint_tpu.certifiers.certifier import InquiringCertifier
+    from tendermint_tpu.certifiers.provider import MemProvider
+    from tendermint_tpu.db.fullcommit import FullCommitStore
+    from tendermint_tpu.db.kv import MemDB
+    from tendermint_tpu.lightclient import BisectingCertifier, CertifiedCommitCache
+    from tendermint_tpu.services.batcher import CoalescingVerifier
+
+    chain_id, source, fcs = _build_fullcommit_chain(heights, n_vals, rotate_every)
+    target = fcs[heights]
+
+    def run(mode: str, walks: int) -> dict:
+        # _DeviceShapeVerifier: emulated fixed launch + tiny per-sig
+        # marginal with host spot checks — the device cost shape, so the
+        # A/B measures launches saved, not host-crypto throughput
+        verifier = _CountingVerifier(
+            CoalescingVerifier(
+                _DeviceShapeVerifier(launch_ms / 1e3),
+                cache_size=0,
+                window_s=0.001,
+            )
+        )
+        try:
+            t0 = time.perf_counter()
+            for _ in range(walks):
+                if mode == "bisect":
+                    cert = BisectingCertifier(
+                        chain_id,
+                        seed=fcs[1],
+                        trusted=MemProvider(),
+                        source=source,
+                        verifier=verifier,
+                    )
+                    cert.verify_to_height(heights)
+                    assert cert.last_height == heights
+                else:
+                    cert = InquiringCertifier(
+                        chain_id,
+                        fcs[1],
+                        MemProvider(),
+                        source,
+                        verifier=verifier,
+                    )
+                    cert.certify(target)
+            elapsed = time.perf_counter() - t0
+        finally:
+            verifier.inner.close()
+        return {
+            "walks": walks,
+            "walks_per_s": round(walks / elapsed, 3),
+            "verifies_per_walk": round(verifier.verifies / walks, 1),
+            "launches_per_walk": round(verifier.launches / walks, 1),
+        }
+
+    sequential = run("sequential", walks=2)
+    bisect = run("bisect", walks=4)
+
+    # serving half: certified-cache lookups + wire encodes (hot-height
+    # skew — what a replica's proof-serving hot loop does per query)
+    cache = CertifiedCommitCache(store=FullCommitStore(MemDB()))
+    for fc in fcs.values():
+        cache.put_certified(fc)
+    import random as _random
+
+    rng = _random.Random(5)
+    n_queries = 2000
+    t0 = time.perf_counter()
+    for _ in range(n_queries):
+        h = (
+            heights - rng.randrange(8)
+            if rng.random() < 0.7
+            else rng.randrange(1, heights + 1)
+        )
+        fc = cache.get_exact(max(1, h))
+        assert fc is not None
+        fc.encode()
+    proofs_per_s = n_queries / (time.perf_counter() - t0)
+
+    return {
+        "heights": heights,
+        "validators": n_vals,
+        "rotate_every": rotate_every,
+        "launch_overhead_ms": launch_ms,
+        "emulated_launch": True,
+        "sequential": sequential,
+        "bisect": bisect,
+        "bisect_speedup": round(
+            bisect["walks_per_s"] / sequential["walks_per_s"], 3
+        ),
+        "verify_reduction": round(
+            sequential["verifies_per_walk"] / max(1.0, bisect["verifies_per_walk"]),
+            3,
+        ),
+        "proofs_served_per_s": round(proofs_per_s, 1),
+    }
+
+
 def drive_coalesce_multiconsumer(rounds: int, batch: int, launch_ms: float) -> dict:
     """All four verify consumers live at once: consensus, fast-sync,
     statesync, and rpc threads submit concurrent async batches through
@@ -1363,6 +1571,45 @@ def main(argv=None) -> int:
         "86 ms axon tunnel)",
     )
     ap.add_argument(
+        "--reads",
+        action="store_true",
+        help="run the reads section (light-client walks: sequential "
+        "InquiringCertifier vs batched bisection over a 256-height "
+        "rotating chain, + proofs-served/s)",
+    )
+    ap.add_argument(
+        "--reads-heights",
+        type=int,
+        default=256,
+        dest="reads_heights",
+        help="chain length the read-path walks bridge",
+    )
+    ap.add_argument(
+        "--reads-vals",
+        type=int,
+        default=8,
+        dest="reads_vals",
+        help="validators signing each reads-bench height",
+    )
+    ap.add_argument(
+        "--reads-rotate-every",
+        type=int,
+        default=8,
+        dest="reads_rotate_every",
+        help="heights between single-validator rotations in the reads "
+        "chain (controls how far each trust jump can skip)",
+    )
+    ap.add_argument(
+        "--reads-launch-ms",
+        type=float,
+        default=86.0,
+        dest="reads_launch_ms",
+        help="emulated launch cost per read-path verify call (the "
+        "86 ms axon-tunnel figure, like --launch-ms: the walk A/B is "
+        "launch-count bound, so the real launch cost is the honest "
+        "weighting)",
+    )
+    ap.add_argument(
         "--finality-heights",
         type=int,
         default=12,
@@ -1488,6 +1735,19 @@ def main(argv=None) -> int:
         mempool_ingress = drive_mempool_ingress(
             args.ingress_txs, args.ingress_threads, args.ingress_launch_ms
         )
+    reads = None
+    if args.reads:
+        sys.stderr.write(
+            f"driving read-path walks: {args.reads_heights} heights x "
+            f"{args.reads_vals} vals, rotate every "
+            f"{args.reads_rotate_every} (sequential vs bisect)...\n"
+        )
+        reads = drive_reads(
+            args.reads_heights,
+            args.reads_vals,
+            args.reads_rotate_every,
+            args.reads_launch_ms,
+        )
     sharded_verify = None
     if args.mesh:
         sys.stderr.write(
@@ -1518,6 +1778,7 @@ def main(argv=None) -> int:
         "profiler_overhead": profiler_overhead,
         "device_efficiency": device_efficiency,
         "mempool_ingress": mempool_ingress,
+        "reads": reads,
         "sharded_verify": sharded_verify,
         "finality": finality,
         "wal_fsync": {

@@ -1,4 +1,4 @@
-"""Open-loop mempool load generator (ROADMAP open item 2).
+"""Open-loop mempool load generator (ROADMAP open item 2) + read traffic.
 
 Drives CheckTx traffic the way "millions of users" would: arrivals are
 scheduled by a fixed-rate open-loop process (a slow mempool does NOT
@@ -8,7 +8,7 @@ client threads, with configurable payload size, hot-key skew, and
 duplicate re-sends (gossip-style re-arrivals that should be near-free
 through the dup cache / VerifiedSigCache).
 
-Two targets:
+Two write targets:
 
 * in-process (default): builds a KVStore app + the production mempool
   shape (sharded lanes + ingress batching over `default_verifier()`),
@@ -16,9 +16,19 @@ Two targets:
   `tendermint_mempool_admission_seconds` histogram a node exports;
 * `--rpc host:port`: fires `broadcast_tx_sync` at a running node.
 
+`--reads` flips the generator into light-client QUERY traffic against
+a replica fleet (`--rpc host:port[,host:port...]`, round-robin):
+proof reads (`full_commit` / `commit` / `validators`) with hot-height
+skew — recent heights are what real users hammer — plus a
+`--walk-prob` fraction of full verify-to-height walks, each a FRESH
+`BisectingCertifier` bootstrapping from the genesis pin through the
+target's proofs (the "new light client joins" workload). The bench and
+nemesis replica scenarios drive this mode.
+
     JAX_PLATFORMS=cpu python tools/loadgen.py --rate 20000 --duration 3
     python tools/loadgen.py --rate 100000 --threads 16 --signed  # TPU
     python tools/loadgen.py --rpc 127.0.0.1:46657 --rate 500
+    python tools/loadgen.py --reads --rpc 127.0.0.1:46657,127.0.0.1:46658
 
 Output: one JSON summary line on stdout.
 """
@@ -133,6 +143,79 @@ def run_inprocess(args, factory: TxFactory, stats: Stats):
     return mp, submit, drain
 
 
+def _rpc_get(target: str, method: str, timeout: float = 30.0, **params):
+    import urllib.parse
+    import urllib.request
+
+    qs = urllib.parse.urlencode(params)
+    url = f"http://{target}/{method}" + (f"?{qs}" if qs else "")
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        out = json.load(resp)
+    if "error" in out and out["error"]:
+        raise RuntimeError(out["error"].get("message", "rpc error"))
+    return out["result"]
+
+
+def run_reads(args, stats: Stats):
+    """Open-loop light-client query traffic against a replica fleet."""
+    targets = [t.strip() for t in args.rpc.split(",") if t.strip()]
+    if not targets:
+        raise SystemExit("--reads needs --rpc host:port[,host:port...]")
+    st = _rpc_get(targets[0], "status")
+    chain_id = st["node_info"]["chain_id"]
+    tip = int(st["sync_info"]["latest_block_height"])
+    gen = _rpc_get(targets[0], "genesis")["genesis"]
+    rng = random.Random(11)
+    rng_lock = threading.Lock()
+    hot_window = max(1, min(args.hot_keys or 8, tip))
+
+    def pick_height() -> int:
+        with rng_lock:
+            if args.hot_prob > 0 and rng.random() < args.hot_prob:
+                return max(1, tip - rng.randrange(hot_window))
+            return rng.randrange(1, tip + 1)
+
+    def do_walk(target: str) -> str:
+        """A fresh light client bootstraps from the genesis pin and
+        verifies to the tip through this replica's proofs."""
+        from tendermint_tpu.certifiers.node_provider import NodeProvider
+        from tendermint_tpu.lightclient import BisectingCertifier
+        from tendermint_tpu.rpc.client import HTTPClient
+        from tendermint_tpu.types.genesis import GenesisDoc
+
+        doc = GenesisDoc.from_json(json.dumps(gen))
+        cert = BisectingCertifier(
+            chain_id,
+            validators=doc.validator_set(),
+            height=0,
+            source=NodeProvider(HTTPClient(target)),
+        )
+        cert.verify_to_height(tip)
+        return "walk"
+
+    def submit(n: int, t_sched: float) -> None:
+        target = targets[n % len(targets)]
+        with rng_lock:
+            r = rng.random()
+        try:
+            if r < args.walk_prob:
+                kind = do_walk(target)
+            elif r < args.walk_prob + 0.5:
+                kind = "full_commit"
+                _rpc_get(target, "full_commit", height=pick_height())
+            elif r < args.walk_prob + 0.75:
+                kind = "commit"
+                _rpc_get(target, "commit", height=pick_height())
+            else:
+                kind = "validators"
+                _rpc_get(target, "validators", height=pick_height())
+            stats.record(kind, time.perf_counter() - t_sched)
+        except Exception:
+            stats.record("error", time.perf_counter() - t_sched)
+
+    return None, submit, lambda: None
+
+
 def run_rpc(args, factory: TxFactory, stats: Stats):
     import urllib.request
 
@@ -181,7 +264,14 @@ def main(argv=None) -> int:
     ap.add_argument("--legacy", action="store_true",
                     help="ingress batching OFF (one-at-a-time admission)")
     ap.add_argument("--rpc", default="", help="host:port of a running node "
-                    "(default: in-process mempool)")
+                    "(default: in-process mempool); comma-separated fleet "
+                    "with --reads")
+    ap.add_argument("--reads", action="store_true",
+                    help="light-client query traffic (proof reads + walks) "
+                    "against a replica fleet instead of CheckTx writes")
+    ap.add_argument("--walk-prob", type=float, default=0.05, dest="walk_prob",
+                    help="fraction of read arrivals that run a full "
+                    "verify-to-height walk (fresh client bootstrap)")
     args = ap.parse_args(argv)
 
     factory = TxFactory(
@@ -189,14 +279,19 @@ def main(argv=None) -> int:
         args.signed, args.signers,
     )
     stats = Stats()
-    mp, submit, drain = (
-        run_rpc(args, factory, stats) if args.rpc
-        else run_inprocess(args, factory, stats)
-    )
+    if args.reads:
+        mp, submit, drain = run_reads(args, stats)
+    else:
+        mp, submit, drain = (
+            run_rpc(args, factory, stats) if args.rpc
+            else run_inprocess(args, factory, stats)
+        )
 
     n_total = int(args.rate * args.duration)
     interval = 1.0 / args.rate if args.rate > 0 else 0.0
     t0 = time.perf_counter() + 0.05  # shared epoch for all threads
+
+    make = (lambda n: n) if args.reads else factory.make
 
     def worker(k: int):
         late = 0
@@ -207,7 +302,7 @@ def main(argv=None) -> int:
                 time.sleep(due - now)
             elif now - due > 0.001:
                 late += 1  # open loop: fire immediately, count the slip
-            submit(factory.make(n), due)
+            submit(make(n), due)
         with stats.lock:
             stats.late_arrivals += late
 
@@ -247,7 +342,10 @@ def main(argv=None) -> int:
         "signed": bool(args.signed),
         "dup_prob": args.dup_prob,
         "hot_prob": args.hot_prob,
-        "mode": "rpc" if args.rpc else ("legacy" if args.legacy else "batched"),
+        "mode": "reads" if args.reads else (
+            "rpc" if args.rpc else ("legacy" if args.legacy else "batched")
+        ),
+        "walk_prob": args.walk_prob if args.reads else None,
         "submitted": n_total,
         "resolved": len(lat),
         "achieved_checktx_per_s": round(len(lat) / wall, 1) if wall > 0 else None,

@@ -341,6 +341,147 @@ def run_pipeline_scenario(args) -> int:
     return 1 if failed else 0
 
 
+def run_replicas_scenario(args) -> int:
+    """Read-replica fleet book (ROADMAP item 1's gate): stateless
+    replicas join a live validator net, follow it via follow-mode
+    fast-sync + the 0x68 FullCommit subscription, and serve
+    light-client reads. Then (a) a forged-FullCommit pusher attacks a
+    replica — the client pin rejects it, the pusher is banned, and the
+    embedded double-sign becomes COMMITTED evidence on the validators
+    while the honest replica keeps answering; (b) the replica fleet is
+    partitioned from the validators — serving lag is reported, reads
+    keep answering from the certified cache, and the fleet converges
+    after heal."""
+    import json as _json
+
+    from tendermint_tpu.testing import Nemesis
+    from tendermint_tpu.testing.byzantine import (
+        ForgedCommitPusher,
+        forge_fullcommit,
+        wait_evidence_committed,
+    )
+    from tendermint_tpu.testing.nemesis import FullNemesisNode
+
+    t_all = time.time()
+    verdicts: list[tuple[str, str, str]] = []
+    home = tempfile.mkdtemp(prefix="nemesis-replicas-")
+
+    def replica_mutator(cfg):
+        cfg.replica.enable = True
+
+    with Nemesis(
+        args.nodes, home=home, node_factory=Nemesis.full_node_factory()
+    ) as net:
+        n_vals = args.nodes
+        print(f"[1/4] {n_vals} validators + {args.replicas} joining replicas ...")
+        net.wait_height(2, timeout=args.timeout)
+        reps = []
+        for k in range(args.replicas):
+            rep = FullNemesisNode(
+                n_vals + k,
+                net.genesis,
+                net.privs,
+                home,
+                net.chain_id,
+                config_mutator=replica_mutator,
+            )
+            net.add_node(rep)
+            reps.append(rep)
+        rep_idx = [n_vals + k for k in range(args.replicas)]
+        target = max(net.heights()) + 2
+        net.wait_height(target, nodes=rep_idx, timeout=args.timeout)
+        certified = [r.node.fullcommit_cache.latest_height() for r in reps]
+        deadline = time.time() + args.timeout
+        while time.time() < deadline and not all(c >= 2 for c in certified):
+            time.sleep(0.2)
+            certified = [r.node.fullcommit_cache.latest_height() for r in reps]
+        verdicts.append(
+            (
+                "replica-follow",
+                "PASS" if all(c >= 2 for c in certified) else "FAIL",
+                f"replicas at heights {[r.height for r in reps]}, certified "
+                f"tips {certified}, consensus never joined "
+                f"({all(r.node.consensus is None for r in reps)})",
+            )
+        )
+
+        print("[2/4] forged FullCommit pushed at replica 0 ...")
+        honest = reps[0].node.lightclient_reactor.serve_commit(2)
+        forged = forge_fullcommit(honest, net.privs[0], net.chain_id)
+        pusher = ForgedCommitPusher(reps[0].node, forged)
+        pusher.push()
+        try:
+            deadline = time.time() + args.timeout
+            while time.time() < deadline and not pusher.banned():
+                time.sleep(0.1)
+            # the embedded double-sign must COMMIT on the validators
+            found = wait_evidence_committed(
+                net,
+                net.privs[0].address,
+                nodes=list(range(n_vals)),
+                timeout=args.timeout,
+            )
+            # the honest replica still answers at the attacked height
+            served = reps[1].node.lightclient_reactor.serve_commit(2)
+            honest_ok = (
+                served is not None
+                and served.header.app_hash == honest.header.app_hash
+            )
+            ok = pusher.banned() and honest_ok
+            verdicts.append(
+                (
+                    "forged-fullcommit",
+                    "PASS" if ok else "FAIL",
+                    f"pusher banned={pusher.banned()}, double-sign evidence "
+                    f"committed at heights {sorted(set(found.values()))}, "
+                    f"honest replica answers={honest_ok}",
+                )
+            )
+        finally:
+            pusher.stop()
+
+        print(f"[3/4] partition validators | replicas ...")
+        net.partition(set(range(n_vals)), set(rep_idx))
+        net.wait_progress(delta=2, nodes=list(range(n_vals)), timeout=args.timeout)
+        stale = [r.height for r in reps]
+        # reads keep answering from the certified cache while cut off
+        served = reps[0].node.lightclient_reactor.serve_commit(0)
+        lag_reported = [
+            r.node.health()["serving"]["serving_lag"] for r in reps
+        ]
+        verdicts.append(
+            (
+                "partitioned-serving",
+                "PASS" if served is not None else "FAIL",
+                f"replica heights frozen at {stale} while validators "
+                f"advanced to {max(net.heights())}; cached tip still "
+                f"served (h={served.height() if served else None}), "
+                f"serving lag reported {lag_reported}",
+            )
+        )
+
+        print("[4/4] heal; replica fleet must converge ...")
+        net.heal()
+        target = max(net.heights()[:n_vals])
+        net.wait_height(target, nodes=rep_idx, timeout=args.timeout)
+        summary = {
+            "heights": net.heights(),
+            "certified": [r.node.fullcommit_cache.latest_height() for r in reps],
+        }
+        verdicts.append(
+            ("partition-heal", "PASS", _json.dumps(summary, separators=(",", ":")))
+        )
+        net.check_invariants()
+
+    print(f"\nreplica book done in {time.time() - t_all:.1f}s:")
+    width = max(len(s) for s, _, _ in verdicts)
+    failed = 0
+    for scenario, verdict, detail in verdicts:
+        print(f"  {scenario:<{width}}  {verdict}  {detail}")
+        failed += verdict != "PASS"
+    return 1 if failed else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
@@ -364,6 +505,13 @@ def main() -> int:
         action="store_true",
         help="run the cross-height pipeline chaos book (faulted apply "
         "drains at the join barrier; forged apply cannot fork) instead",
+    )
+    ap.add_argument(
+        "--replicas",
+        type=int,
+        default=0,
+        help="run the read-replica fleet book with this many replicas "
+        "(forged-FullCommit attribution; fleet under partition) instead",
     )
     ap.add_argument("--rate", type=float, default=150.0, help="ingress tx/s")
     ap.add_argument("--txs", type=int, default=1000, help="ingress tx cap")
@@ -389,6 +537,12 @@ def main() -> int:
 
         setup_logging("nemesis:info,*:error")
         return run_pipeline_scenario(args)
+
+    if args.replicas > 0:
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging("lightclient:warning,nemesis:info,*:error")
+        return run_replicas_scenario(args)
 
     from tendermint_tpu.services.resilient import ResilientVerifier
     from tendermint_tpu.services.verifier import HostBatchVerifier
