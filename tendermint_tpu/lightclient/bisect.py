@@ -45,7 +45,9 @@ from tendermint_tpu.certifiers.certifier import FullCommit
 from tendermint_tpu.telemetry import TRACER
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.types.errors import (
+    ErrNoSourceCommit,
     ErrTooMuchChange,
+    ErrTrustExpired,
     ErrValidatorsChanged,
     ValidationError,
 )
@@ -146,6 +148,10 @@ class BisectingCertifier:
         height first when the valset changed (the `InquiringCertifier.
         certify` contract, minus the sequential walk)."""
         fc.validate_basic(self.chain_id)
+        # the trust-period rule gates EVERY extension of trust, the
+        # direct same-valset path included: past the unbonding window
+        # the pinned validators can sign anything without slashing risk
+        self._check_trust_fresh()
         if fc.header.validators_hash != self._valset.hash():
             self.verify_to_height(fc.height())
             if fc.header.validators_hash != self._valset.hash():
@@ -175,7 +181,17 @@ class BisectingCertifier:
         except ErrTooMuchChange:
             _metrics.LIGHTCLIENT_BISECTIONS.labels(result="too_much_change").inc()
             raise
+        except ErrTrustExpired:
+            _metrics.LIGHTCLIENT_BISECTIONS.labels(result="trust_expired").inc()
+            raise
+        except ErrNoSourceCommit:
+            _metrics.LIGHTCLIENT_BISECTIONS.labels(result="no_source").inc()
+            raise
         except ValidationError:
+            # only genuine candidate defects (bad signature, impossible
+            # quorum, malformed votes) land here — the forgery signal
+            # operators alert on must not be polluted by client-side
+            # staleness or fetch failures (the typed errors above)
             _metrics.LIGHTCLIENT_BISECTIONS.labels(result="forged").inc()
             raise
         _metrics.LIGHTCLIENT_BISECTIONS.labels(result="ok").inc()
@@ -201,7 +217,7 @@ class BisectingCertifier:
             return
         age = self._now_ns() - self._time_ns
         if age > self.trust_period_ns:
-            raise ValidationError(
+            raise ErrTrustExpired(
                 f"light-client trust expired: trusted header is "
                 f"{age / 1e9:.0f}s old, trust period "
                 f"{self.trust_period_ns / 1e9:.0f}s — re-initialize the pin"
@@ -209,14 +225,16 @@ class BisectingCertifier:
 
     def _walk(self, target: int) -> None:
         if self.source is None:
-            raise ValidationError("no source provider to walk")
+            raise ErrNoSourceCommit("no source provider to walk")
         self._restart_from_trusted(target)
         self._check_trust_fresh()
         if target <= self._height:
             return
         sfc = self.source.get_by_height(target)
         if sfc is None:
-            raise ValidationError(f"no source commit at/below height {target}")
+            raise ErrNoSourceCommit(
+                f"no source commit at/below height {target}"
+            )
         if sfc.height() <= self._height:
             return  # source lags our trust: nothing newer to learn
         target = sfc.height()
@@ -304,7 +322,18 @@ class BisectingCertifier:
         candidate's OWN valset (the signatures are the new set's), with
         per-lane old-set power credit for validators the trusted set
         also contains. Malformed votes fail hard — a legit provider
-        never serves them."""
+        never serves them.
+
+        Trusted-set credit requires the trusted validator's KEY, not
+        just its address: the lane signature is verified under
+        `new_val.pub_key`, and the untrusted candidate valset binds
+        addresses to whatever pubkeys its author chose. Crediting by
+        address alone would let a forger reuse every trusted address
+        with attacker keys and fake the >1/3 overlap (the same rule
+        `verify_commit_any` enforces by verifying overlap signatures
+        under `old_val.pub_key`). Each trusted validator is credited at
+        most once per candidate, so a replayed signature in duplicate
+        lanes cannot double-count old power."""
         old = self._valset
         new = fc.validators
         commit = fc.commit
@@ -313,6 +342,7 @@ class BisectingCertifier:
             raise ValidationError("commit size != valset size")
         round_ = commit.round()
         prep = _SkipPrep(fc=fc)
+        seen_old: set[bytes] = set()
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
                 continue
@@ -332,9 +362,15 @@ class BisectingCertifier:
                 )
             )
             prep.new_powers.append(new_val.voting_power)
-            prep.old_powers.append(
-                old_val.voting_power if old_val is not None else 0
-            )
+            old_credit = 0
+            if (
+                old_val is not None
+                and old_val.pub_key.data == new_val.pub_key.data
+                and old_val.address not in seen_old
+            ):
+                seen_old.add(old_val.address)
+                old_credit = old_val.voting_power
+            prep.old_powers.append(old_credit)
         return prep
 
     def _verify_candidates(self, fcs: list[FullCommit]) -> list[bool]:

@@ -74,13 +74,16 @@ class CertifiedCommitCache(Provider):
         lock, entries = self._shard(h)
         with lock:
             entries[h] = fc
+        self._index_insert(h)
+        if self.store is not None:
+            self.store.store_commit(fc)
+        self._evict_over_capacity()
+
+    def _index_insert(self, h: int) -> None:
         with self._index_lock:
             i = _bisect.bisect_left(self._heights, h)
             if i >= len(self._heights) or self._heights[i] != h:
                 self._heights.insert(i, h)
-        if self.store is not None:
-            self.store.store_commit(fc)
-        self._evict_over_capacity()
 
     def store_commit(self, fc: FullCommit) -> None:
         self.put_certified(fc)
@@ -113,9 +116,14 @@ class CertifiedCommitCache(Provider):
         if self.store is not None:
             fc = self.store.get_exact(height)
             if fc is not None:
-                # re-admit the durable entry to the hot tier
+                # re-admit the durable entry to the hot tier — and back
+                # into the height index, or the evictor (which only
+                # drops heights it pops from the index) never sees it
+                # and the shard grows without bound
                 with lock:
                     entries[height] = fc
+                self._index_insert(height)
+                self._evict_over_capacity()
                 _metrics.LIGHTCLIENT_CACHE_HITS.inc()
                 return fc
         _metrics.LIGHTCLIENT_CACHE_MISSES.inc()

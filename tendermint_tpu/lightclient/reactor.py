@@ -42,7 +42,9 @@ from tendermint_tpu.p2p.peer import Peer
 from tendermint_tpu.p2p.switch import Reactor
 from tendermint_tpu.telemetry import metrics as _metrics
 from tendermint_tpu.types.errors import (
+    ErrNoSourceCommit,
     ErrTooMuchChange,
+    ErrTrustExpired,
     ErrValidatorsChanged,
     ValidationError,
 )
@@ -131,8 +133,10 @@ class LightClientReactor(Reactor):
         # leaf lock: held over set/dict surgery only, never across sends
         self._mtx = ranked_lock("lightclient.reactor")
         self._subscribers: set[str] = set()
-        # request correlation: height -> (event, [FullCommit|None])
-        self._waits: dict[int, tuple[threading.Event, list]] = {}
+        # request correlation: height -> list of (event, box) waiters —
+        # a LIST so concurrent same-height requests each keep their own
+        # slot instead of clobbering a shared one
+        self._waits: dict[int, list[tuple[threading.Event, list]]] = {}
         # subscription-liveness clock (health's serving section)
         self._last_push_mono: float | None = None
         self._last_pushed_height = 0
@@ -185,10 +189,10 @@ class LightClientReactor(Reactor):
         elif kind == "fc_response":
             height, fc = arg
             with self._mtx:
-                wait = self._waits.get(height)
-            if wait is not None:
-                wait[1].append(fc)
-                wait[0].set()
+                waiters = list(self._waits.get(height, ()))
+            for ev, box in waiters:
+                box.append(fc)
+                ev.set()
         elif kind == "fc_subscribe":
             with self._mtx:
                 self._subscribers.add(peer.id)
@@ -322,6 +326,20 @@ class LightClientReactor(Reactor):
             # can't bridge to this height YET (e.g. still fast-syncing
             # through a valset rotation) — drop, a later push will land
             return
+        except (ErrTrustExpired, ErrNoSourceCommit) as e:
+            # CLIENT-side failure (stale local pin, bisection fetch
+            # timed out mid-walk) — the pushing peer did nothing wrong;
+            # scoring it here would ban honest peers and can partition
+            # a replica fleet
+            kv(
+                _log,
+                logging.DEBUG,
+                "fullcommit push dropped (environmental)",
+                height=fc.height(),
+                from_peer=peer_id[:12],
+                error=str(e)[:80],
+            )
+            return
         except ValidationError as e:
             self._handle_forged(peer_id, fc, e)
             return
@@ -386,8 +404,9 @@ class LightClientReactor(Reactor):
             return None
         ev = threading.Event()
         box: list = []
+        waiter = (ev, box)
         with self._mtx:
-            self._waits[height] = (ev, box)
+            self._waits.setdefault(height, []).append(waiter)
         try:
             for peer in self.switch.peers():
                 ev.clear()
@@ -397,7 +416,14 @@ class LightClientReactor(Reactor):
             return None
         finally:
             with self._mtx:
-                self._waits.pop(height, None)
+                waiters = self._waits.get(height)
+                if waiters is not None:
+                    try:
+                        waiters.remove(waiter)
+                    except ValueError:
+                        pass
+                    if not waiters:
+                        self._waits.pop(height, None)
 
     # -- health --------------------------------------------------------------
 

@@ -531,7 +531,11 @@ for _result in ("ok", "corrupt", "timeout"):
 # the target), too_much_change (bisection bottomed out — the valset
 # churned faster than the source's commit density can bridge), forged
 # (a candidate carried an invalid signature / impossible quorum — a
-# provider offense, never a bisection trigger). `mode` distinguishes
+# provider offense, never a bisection trigger), trust_expired (the
+# LOCAL pin outlived the trust period — operator action, not a peer
+# offense), no_source (the source provider had nothing to offer —
+# fetch timeout / lagging provider, environmental). Only `forged` is
+# an alertable provider offense. `mode` distinguishes
 # the legacy header-by-header walk (sequential — the
 # InquiringCertifier baseline) from the skipping walk (bisect).
 # `kind` on the proofs-served counter is the fixed query taxonomy
@@ -540,7 +544,8 @@ for _result in ("ok", "corrupt", "timeout"):
 
 LIGHTCLIENT_BISECTIONS = Counter(
     "tendermint_lightclient_bisections_total",
-    "Skipping-verification walks by outcome (ok / too_much_change / forged)",
+    "Skipping-verification walks by outcome (ok / too_much_change / "
+    "forged / trust_expired / no_source)",
     labelnames=("result",),
 )
 LIGHTCLIENT_WALK_SECONDS = Histogram(
@@ -566,7 +571,7 @@ REPLICA_PROOFS_SERVED = Counter(
     labelnames=("kind",),
 )
 
-for _result in ("ok", "too_much_change", "forged"):
+for _result in ("ok", "too_much_change", "forged", "trust_expired", "no_source"):
     LIGHTCLIENT_BISECTIONS.labels(result=_result).inc(0)
 for _mode in ("sequential", "bisect"):
     LIGHTCLIENT_WALK_SECONDS.labels(mode=_mode)

@@ -68,6 +68,20 @@ class ErrTooMuchChange(ValidationError):
     types/validator_set.go:284-349`)."""
 
 
+class ErrTrustExpired(ValidationError):
+    """The light client's trusted header outlived the trust period, so
+    the skip rule lost its slashing backstop — the pin must be
+    re-initialized. A CLIENT-side condition: never evidence that the
+    serving peer forged anything."""
+
+
+class ErrNoSourceCommit(ValidationError):
+    """The source provider had no commit to offer (peer fetch timed
+    out, provider lags the requested height, or no provider is wired).
+    An environmental fetch failure, not a forgery — callers must not
+    score the serving peer for it."""
+
+
 class ErrDoubleSign(TMError):
     """PrivValidator refused to sign: height/round/step regression or
     conflicting sign-bytes (reference `types/priv_validator.go:225-275`)."""
