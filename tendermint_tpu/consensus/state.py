@@ -49,6 +49,7 @@ from tendermint_tpu.types.errors import (
     ErrVoteInvalidValidatorAddress,
     ErrVoteInvalidValidatorIndex,
     ErrVoteNonDeterministicSignature,
+    ErrVoteUnexpectedStep,
     FatalConsensusError,
     ValidationError,
 )
@@ -1874,6 +1875,12 @@ class ConsensusState:
             ErrVoteInvalidValidatorIndex,
         ) as e:
             self._report_misbehavior(peer_id, "bad_vote", str(e))
+        except ErrVoteUnexpectedStep:
+            # A vote whose (height, round, type) misses every live
+            # tally — a straggler from a round the node has moved past.
+            # Routine under WAN delay/reordering; never actionable and
+            # must not take the receive loop down with it.
+            pass
 
     def _handle_vote_inner(
         self, vote: Vote, peer_id: str, preverified: bool = False
@@ -1885,6 +1892,14 @@ class ConsensusState:
                 and vote.type == VOTE_TYPE_PRECOMMIT
                 and self.last_commit is not None
             ):
+                if vote.round != self.last_commit.round:
+                    # last_commit only tallies the round the block
+                    # actually committed in. Under WAN reordering a
+                    # straggler precommit from an earlier round of H-1
+                    # is benign gossip noise — drop it instead of
+                    # letting VoteSet raise ErrVoteUnexpectedStep,
+                    # which would kill the receive loop.
+                    return
                 if self.last_commit.add_vote(
                     vote, verifier=self.verifier, preverified=preverified
                 ):

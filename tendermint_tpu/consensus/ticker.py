@@ -113,6 +113,18 @@ class AdaptiveTimeouts:
     MIN_HEIGHTS = 8  # ledger records before derivation engages
     WINDOW = 64  # newest heights considered
     SAFETY = 3.0  # measured -> timeout headroom multiplier
+    # WAN floor: a timeout below ~1.5x the measured one-way network
+    # delay can NEVER gather remote input — it expires while the
+    # honest answer is still in flight. The original fixed floor
+    # (`timeout_derived_floor`, 2 ms) was fit to in-process links;
+    # under 100–300 ms WAN RTTs it let a fast-measured phase derive a
+    # timeout shorter than the wire, and every such round is a
+    # guaranteed spurious skip (validated by the slow-WAN scenario,
+    # testing/scenario.py). The estimate is the byzantine-robust
+    # median-of-per-peer-means, and the configured ceiling still wins,
+    # so a minority of slow-stamping peers can only ever raise the
+    # floor toward physics, never past the operator's cap.
+    RTT_FLOOR_MULT = 1.5
 
     def __init__(self, config, rollup=None, ledger=None) -> None:
         self.config = config
@@ -153,14 +165,22 @@ class AdaptiveTimeouts:
         )
 
     def _floor(self) -> float:
-        return getattr(self.config, "timeout_derived_floor", 2) / 1000.0
+        """Configured static floor OR the measured-RTT floor, whichever
+        is higher — derived timeouts never drop below what the network
+        round trip physically requires (clamping to the ceiling happens
+        in `_derive`, so the operator's cap still dominates)."""
+        static = getattr(self.config, "timeout_derived_floor", 2) / 1000.0
+        rtt = self._arrival_estimate()
+        if rtt is None:
+            return static
+        return max(static, rtt * self.RTT_FLOOR_MULT)
 
     def _derive(self, phase: str, measured: float | None, ceiling: float) -> float:
         """Clamp SAFETY×measured into [floor, ceiling]; None → ceiling
         (the configured fixed value — cold start / opt-out)."""
         if measured is None or not self._enabled():
             return ceiling
-        derived = max(self._floor(), min(ceiling, measured * self.SAFETY))
+        derived = max(min(ceiling, self._floor()), min(ceiling, measured * self.SAFETY))
         from tendermint_tpu.telemetry import metrics as _metrics
 
         _metrics.CONSENSUS_TIMEOUT_DERIVED.labels(phase=phase).set(derived)

@@ -377,6 +377,7 @@ SPAN_CATALOG = frozenset(
         "mempool.admission",
         "mempool.window",
         "p2p.hop",
+        "scenario.run",
         "batcher.flush",
         "dispatch.launch",
         "tx.e2e",
@@ -641,6 +642,55 @@ for _kind in (
     "flood",
 ):
     PEER_MISBEHAVIOR.labels(kind=_kind).inc(0)
+
+# -- WAN link chaos + scenario engine (p2p/transport.py, testing/) ------------
+#
+# `result` on the link-send counter is the fixed delivery taxonomy of
+# the chaos layer: delivered (immediate), delayed (rode the delivery
+# wheel), dup (extra copy scheduled), dropped, partitioned. No per-link
+# labels — a WAN harness runs O(n^2) links and peer-pair series would
+# be unbounded; `tools/scenario_run.py` reports are per-link instead.
+
+LINK_SENDS = Counter(
+    "tendermint_link_sends_total",
+    "ChaosEndpoint sends by delivery outcome (delivered / delayed / "
+    "dup / dropped / partitioned)",
+    labelnames=("result",),
+)
+LINK_DELIVERY_DELAY = Histogram(
+    "tendermint_link_delivery_delay_seconds",
+    "Extra latency injected per delayed delivery (propagation delay + "
+    "jitter + bandwidth serialization), as scheduled on the wheel",
+    buckets=LATENCY_BUCKETS,
+)
+LINK_BANDWIDTH_WAIT = Histogram(
+    "tendermint_link_bandwidth_wait_seconds",
+    "Token-bucket serialization wait per bandwidth-capped send (the "
+    "queueing component of the injected delay)",
+    buckets=LATENCY_BUCKETS,
+)
+LINK_INFLIGHT = Gauge(
+    "tendermint_link_inflight_deliveries",
+    "Delayed deliveries pending on the shared delivery wheel (the "
+    "thread-count regression signal: one thread serves all of these)",
+)
+SCENARIO_RUNS = Counter(
+    "tendermint_scenario_runs_total",
+    "Declarative scenarios executed by ScenarioRunner, by verdict",
+    labelnames=("result",),
+)
+SCENARIO_SECONDS = Histogram(
+    "tendermint_scenario_seconds",
+    "Wall time per executed scenario (build + run + report)",
+    buckets=LATENCY_BUCKETS,
+)
+
+for _result in (
+    "delivered", "delayed", "dup", "dropped", "partitioned", "congested",
+):
+    LINK_SENDS.labels(result=_result).inc(0)
+for _result in ("pass", "fail"):
+    SCENARIO_RUNS.labels(result=_result).inc(0)
 
 # -- evidence -----------------------------------------------------------------
 

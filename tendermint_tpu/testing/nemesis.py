@@ -73,10 +73,16 @@ class InvariantViolation(AssertionError):
     """A safety invariant broke under chaos — the bug this harness hunts."""
 
 
-def make_genesis(n_vals: int, chain_id: str):
+def make_genesis(n_vals: int, chain_id: str, n_active: int | None = None):
     """Deterministic genesis + index-aligned priv validators (the
     `tests/helpers.py` fixture shape, owned here so the harness is
-    importable outside the test tree)."""
+    importable outside the test tree).
+
+    `n_active` caps how many of the `n_vals` keys enter the GENESIS
+    valset; the rest form a standby pool for churn scenarios — their
+    nodes run as non-validators until an EndBlock rotation admits them
+    (returned privs stay index-aligned: valset order first, then the
+    standby pool in deterministic key order)."""
     from tendermint_tpu.crypto import PrivKey
     from tendermint_tpu.types import PrivValidator, Validator, ValidatorSet
     from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
@@ -85,14 +91,17 @@ def make_genesis(n_vals: int, chain_id: str):
         PrivValidator(PrivKey(i.to_bytes(32, "little")))
         for i in range(1, n_vals + 1)
     ]
+    active = privs if n_active is None else privs[:n_active]
     vs = ValidatorSet(
         [
             Validator(address=p.address, pub_key=p.pub_key, voting_power=10)
-            for p in privs
+            for p in active
         ]
     )
-    by_addr = {p.address: p for p in privs}
-    ordered = [by_addr[v.address] for v in vs.validators]
+    by_addr = {p.address: p for p in active}
+    ordered = [by_addr[v.address] for v in vs.validators] + [
+        p for p in privs if p.address not in by_addr
+    ]
     genesis = GenesisDoc(
         chain_id=chain_id,
         genesis_time=1_700_000_000_000_000_000,
@@ -180,6 +189,7 @@ class NemesisNode:
         config=None,
         verifier=None,
         hasher=None,
+        app_factory=None,
     ) -> None:
         from tendermint_tpu.abci.apps import KVStoreApp
         from tendermint_tpu.db.kv import MemDB
@@ -197,7 +207,7 @@ class NemesisNode:
         # app-side persistence is the app's concern (the reference
         # Handshaker replays it back in sync); modeling a durable app
         # keeps the harness focused on consensus-side recovery
-        self.app = KVStoreApp()
+        self.app = (app_factory or KVStoreApp)()
         self.wal_path = os.path.join(home, f"node{index}", "cs.wal")
         os.makedirs(os.path.dirname(self.wal_path), exist_ok=True)
         state = make_genesis_state(self.state_db, genesis)
@@ -448,13 +458,16 @@ class Nemesis:
         hasher_factory=None,
         monitor_interval_s: float = 0.25,
         node_factory=None,
+        n_active: int | None = None,
     ) -> None:
         import tempfile
 
         self.chain_id = chain_id
         self.home = home or tempfile.mkdtemp(prefix="nemesis-")
         self.fuzz = fuzz
-        genesis, privs = make_genesis(n_vals or n_nodes, chain_id=chain_id)
+        genesis, privs = make_genesis(
+            n_vals or n_nodes, chain_id=chain_id, n_active=n_active
+        )
         self.genesis, self.privs = genesis, privs
         self.node_factory = node_factory or NemesisNode
         self.nodes = [
@@ -473,10 +486,33 @@ class Nemesis:
         # (i, j) i<j -> (chaos i->j, chaos j->i); flags survive re-links
         self._links: dict[tuple[int, int], tuple[LinkChaos, LinkChaos]] = {}
         self._partition: list[set[int]] | None = None
+        self._topology = None  # WanTopology; reshapes recreated links
         self._monitor_interval = monitor_interval_s
         self._monitor: threading.Thread | None = None
         self._monitor_stop = threading.Event()
         self.violations: list[str] = []
+
+    @staticmethod
+    def core_node_factory(app_factory=None):
+        """A `node_factory` building consensus-core `NemesisNode`s with
+        a custom ABCI app per node (e.g. the churn app rotating the
+        valset at EndBlock). The factory is called once per node, in
+        index order — `one_bad_app_factory` composes."""
+
+        def factory(i, genesis, privs, home, chain_id, config=None, verifier=None, hasher=None):
+            return NemesisNode(
+                i,
+                genesis,
+                privs,
+                home,
+                chain_id,
+                config=config,
+                verifier=verifier,
+                hasher=hasher,
+                app_factory=app_factory,
+            )
+
+        return factory
 
     @staticmethod
     def full_node_factory(app_factory=None, config_mutator=None):
@@ -550,7 +586,34 @@ class Nemesis:
             if self._partition is not None and self._crosses_partition(i, j):
                 for c in self._links[key]:
                     c.partitioned = True
+            if self._topology is not None:
+                # recreated links (restart) must re-inherit the WAN shape
+                self._topology.shape(self._links[key][0], key[0], key[1])
+                self._topology.shape(self._links[key][1], key[1], key[0])
         return self._links[key]
+
+    def link_chaos(self, i: int, j: int) -> LinkChaos:
+        """The live LinkChaos governing direction i -> j (asymmetric
+        routes are two calls)."""
+        pair = self._chaos_pair(i, j)
+        return pair[0] if i < j else pair[1]
+
+    def set_topology(self, topology) -> None:
+        """Shape every link (delay / jitter / bandwidth, per direction)
+        from a WAN topology (`testing/topology.py`). Stored so links
+        recreated by `restart()` inherit the shaping, exactly like the
+        live partition flags."""
+        self._topology = topology
+        for (i, j), (c_ij, c_ji) in self._links.items():
+            topology.shape(c_ij, i, j)
+            topology.shape(c_ji, j, i)
+        kv(
+            _log,
+            logging.INFO,
+            "topology applied",
+            name=getattr(topology, "name", "custom"),
+            links=len(self._links),
+        )
 
     def _connect(self, i: int, j: int) -> None:
         c_ij, c_ji = self._chaos_pair(i, j)

@@ -1305,6 +1305,79 @@ def drive_finality(
     }
 
 
+def drive_scenario_finality(names) -> dict:
+    """`scenario_finality` section: the declarative scenario library
+    (PR 16) run end-to-end — WAN topology shaping + fault timelines +
+    validator churn on live Nemesis nets — with each scenario's
+    committed finality floor graded by the runner itself. Includes the
+    adaptive-timeout A/B on the slow-WAN topology: the adaptive leg
+    must converge its propose timeout above the injected one-way delay
+    and stop skipping rounds once warm, while the fixed-short leg
+    (same fabric, adaptive off, 10 ms propose) keeps paying round
+    skips every time the far validator proposes — the measured, not
+    asserted, case for measured-latency timeouts on real WAN RTTs."""
+    import copy
+    import tempfile
+
+    from tendermint_tpu.testing.scenario import SCENARIO_LIBRARY, ScenarioRunner
+
+    out: dict = {"scenarios": {}, "all_pass": True}
+    for name in names:
+        spec = copy.deepcopy(SCENARIO_LIBRARY[name])
+        sys.stderr.write(f"  scenario {name}...\n")
+        report = ScenarioRunner(
+            home=tempfile.mkdtemp(prefix=f"hotpath-scn-{name}-")
+        ).run(spec)
+        entry = {
+            "ok": report["ok"],
+            "elapsed_s": report["elapsed_s"],
+            "min_height": min(report["heights"], default=0),
+            "finality": report["finality"],
+            "round_skips_post_warm": report["round_skips_post_warm"],
+        }
+        for key in ("epochs", "valset_rebuilds"):
+            if key in report:
+                entry[key] = report[key]
+        if report["failures"]:
+            entry["failures"] = report["failures"]
+        out["scenarios"][name] = entry
+        out["all_pass"] = bool(out["all_pass"] and report["ok"])
+
+    legs: dict = {}
+    for label in ("adaptive", "fixed_short"):
+        spec = copy.deepcopy(SCENARIO_LIBRARY["slow_wan_validator"])
+        spec["name"] = f"slow_wan_{label}"
+        if label == "fixed_short":
+            spec["config"]["adaptive_timeouts"] = False
+            spec["config"]["timeout_propose_ms"] = 10  # < one-way delay
+            spec["expect"].pop("adaptive_above_max_delay", None)
+            spec["expect"].pop("max_round_skips_post_warm", None)
+        sys.stderr.write(f"  A/B leg {label}...\n")
+        report = ScenarioRunner(
+            home=tempfile.mkdtemp(prefix=f"hotpath-ab-{label}-")
+        ).run(spec)
+        leg = {
+            "ok": report["ok"],
+            "round_skips_post_warm": report["round_skips_post_warm"],
+            "finality_p50_s": report["finality"].get("p50_s"),
+        }
+        if "propose_timeout_s" in report:
+            leg["propose_timeout_s"] = report["propose_timeout_s"]
+            leg["max_one_way_delay_s"] = report["max_one_way_delay_s"]
+        legs[label] = leg
+        out["all_pass"] = bool(out["all_pass"] and report["ok"])
+    # The headline A/B number: how much slower finality gets when the
+    # propose timeout is pinned below the one-way WAN delay instead of
+    # adapting to it. Round-skip counters only see skip-ahead jumps, so
+    # the latency ratio is the robust degradation signal.
+    adaptive_p50 = legs["adaptive"].get("finality_p50_s")
+    fixed_p50 = legs["fixed_short"].get("finality_p50_s")
+    if adaptive_p50 and fixed_p50:
+        legs["finality_p50_ratio"] = round(fixed_p50 / adaptive_p50, 3)
+    out["adaptive_ab"] = legs
+    return out
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -1623,6 +1696,14 @@ def main(argv=None) -> int:
         dest="finality_loaded",
         help="heights measured under open-loop CheckTx traffic",
     )
+    ap.add_argument(
+        "--scenarios",
+        default="churn_small,flash_crowd",
+        help="comma-separated scenario library entries for the "
+        "scenario_finality section (empty skips the section; the "
+        "adaptive-timeout A/B on the slow-WAN topology always rides "
+        "with it)",
+    )
     args = ap.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
@@ -1765,6 +1846,14 @@ def main(argv=None) -> int:
             f"{args.finality_loaded} loaded heights x 4 validators...\n"
         )
         finality = drive_finality(args.finality_heights, args.finality_loaded)
+    scenario_finality = None
+    scenario_names = [s for s in args.scenarios.split(",") if s]
+    if scenario_names:
+        sys.stderr.write(
+            f"driving scenario library: {', '.join(scenario_names)} "
+            "+ adaptive-timeout A/B...\n"
+        )
+        scenario_finality = drive_scenario_finality(scenario_names)
     detail = {
         "wall_s": round(time.time() - t0, 2),
         "backend": jax.default_backend(),
@@ -1781,6 +1870,7 @@ def main(argv=None) -> int:
         "reads": reads,
         "sharded_verify": sharded_verify,
         "finality": finality,
+        "scenario_finality": scenario_finality,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,

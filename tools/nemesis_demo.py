@@ -482,6 +482,46 @@ def run_replicas_scenario(args) -> int:
     return 1 if failed else 0
 
 
+def run_scenarios_scenario(args) -> int:
+    """Declarative scenario-library book: every library entry — WAN
+    slow-validator, validator churn, flash crowd, regional outage,
+    churn storm, partition-during-churn — runs end-to-end through the
+    ScenarioRunner and is graded against its committed expectations
+    (finality SLOs, epoch counts, adaptive-timeout convergence,
+    light-client bisection across rotations)."""
+    from tendermint_tpu.testing.scenario import run_library
+
+    t_all = time.time()
+    home = tempfile.mkdtemp(prefix="nemesis-scenarios-")
+    reports = run_library(home=home, include_slow=not args.fast)
+    verdicts: list[tuple[str, str, str]] = []
+    for report in reports:
+        fin = report["finality"]
+        detail = f"heights {report['heights']}"
+        if fin.get("count"):
+            detail += f", finality p95 {fin['p95_s']:.2f}s"
+        if "epochs" in report:
+            detail += (
+                f", {report['epochs']} epochs / "
+                f"{report['valset_rebuilds']} rebuilds"
+            )
+        if "bisection" in report:
+            detail += f", bisected to h{report['bisection']['verified_to']}"
+        if report["failures"]:
+            detail += f" — {'; '.join(report['failures'])}"
+        verdicts.append(
+            (report["scenario"], "PASS" if report["ok"] else "FAIL", detail)
+        )
+
+    print(f"\nscenario book done in {time.time() - t_all:.1f}s:")
+    width = max(len(s) for s, _, _ in verdicts)
+    failed = 0
+    for scenario, verdict, detail in verdicts:
+        print(f"  {scenario:<{width}}  {verdict}  {detail}")
+        failed += verdict != "PASS"
+    return 1 if failed else 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=4)
@@ -513,6 +553,17 @@ def main() -> int:
         help="run the read-replica fleet book with this many replicas "
         "(forged-FullCommit attribution; fleet under partition) instead",
     )
+    ap.add_argument(
+        "--scenarios",
+        action="store_true",
+        help="run the declarative scenario-library book (WAN topologies, "
+        "validator churn, flash crowd, regional outage) instead",
+    )
+    ap.add_argument(
+        "--fast",
+        action="store_true",
+        help="with --scenarios: tier-1 entries only, skip the slow ones",
+    )
     ap.add_argument("--rate", type=float, default=150.0, help="ingress tx/s")
     ap.add_argument("--txs", type=int, default=1000, help="ingress tx cap")
     ap.add_argument(
@@ -537,6 +588,12 @@ def main() -> int:
 
         setup_logging("nemesis:info,*:error")
         return run_pipeline_scenario(args)
+
+    if args.scenarios:
+        from tendermint_tpu.utils.log import setup_logging
+
+        setup_logging("scenario:info,nemesis:warning,*:error")
+        return run_scenarios_scenario(args)
 
     if args.replicas > 0:
         from tendermint_tpu.utils.log import setup_logging

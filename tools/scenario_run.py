@@ -1,0 +1,149 @@
+"""Run declarative chaos scenarios from the library or a JSON spec.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/scenario_run.py --list
+    JAX_PLATFORMS=cpu python tools/scenario_run.py --scenario churn_small
+    JAX_PLATFORMS=cpu python tools/scenario_run.py --all --json /tmp/reports.json
+    JAX_PLATFORMS=cpu python tools/scenario_run.py --spec my_scenario.json
+
+Each scenario spins up an in-process Nemesis network, applies the
+declared WAN topology / churn schedule / fault timeline / load
+profile, grades the run against the spec's `expect` block (finality
+SLOs, epoch counts, adaptive-timeout convergence, bisection bridging),
+and prints a per-scenario report. Exits non-zero if any scenario
+fails its invariants or expectations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt_finality(fin: dict) -> str:
+    if not fin or not fin.get("count"):
+        return "no finality samples"
+    return (
+        f"finality p50={fin['p50_s']:.2f}s p95={fin['p95_s']:.2f}s "
+        f"max={fin['max_s']:.2f}s over {fin['count']} heights"
+    )
+
+
+def _detail(report: dict) -> str:
+    bits = [f"heights {report['heights']}", _fmt_finality(report["finality"])]
+    if "epochs" in report:
+        bits.append(
+            f"epochs={report['epochs']} rebuilds={report['valset_rebuilds']}"
+        )
+    if "bisection" in report:
+        b = report["bisection"]
+        bits.append(
+            f"bisected to h{b['verified_to']} in {b['rounds']} rounds"
+        )
+    if "propose_timeout_s" in report:
+        bits.append(
+            f"propose timeout {report['propose_timeout_s']['min']:.3f}s "
+            f"> one-way delay {report['max_one_way_delay_s']:.3f}s"
+        )
+    skips = report.get("round_skips_post_warm")
+    if skips is not None:
+        bits.append(f"post-warm skips={skips}")
+    return ", ".join(bits)
+
+
+def main() -> int:
+    from tendermint_tpu.testing.scenario import (
+        SCENARIO_LIBRARY,
+        ScenarioRunner,
+        validate_scenario,
+    )
+    from tendermint_tpu.utils.log import setup_logging
+
+    ap = argparse.ArgumentParser(
+        description="declarative chaos scenario runner"
+    )
+    ap.add_argument("--list", action="store_true", help="list library scenarios")
+    ap.add_argument(
+        "--scenario",
+        action="append",
+        default=None,
+        metavar="NAME",
+        help="run this library scenario (repeatable)",
+    )
+    ap.add_argument(
+        "--all", action="store_true", help="run the entire library, slow included"
+    )
+    ap.add_argument(
+        "--spec",
+        metavar="PATH",
+        default=None,
+        help="run a scenario spec from a JSON file instead of the library",
+    )
+    ap.add_argument(
+        "--json",
+        metavar="PATH",
+        default=None,
+        help="also write the full reports as JSON here",
+    )
+    ap.add_argument("--home", default=None, help="scratch dir (default: tmp)")
+    args = ap.parse_args()
+
+    if args.list:
+        width = max(len(n) for n in SCENARIO_LIBRARY)
+        for name, spec in SCENARIO_LIBRARY.items():
+            tier = "slow" if spec.get("slow", True) else "tier-1"
+            print(f"  {name:<{width}}  [{tier:>6}]  {spec['description']}")
+        return 0
+
+    setup_logging("scenario:info,nemesis:warning,*:error")
+
+    specs: list[dict] = []
+    if args.spec:
+        with open(args.spec) as fh:
+            specs.append(validate_scenario(json.load(fh)))
+    elif args.all:
+        specs = [dict(s) for s in SCENARIO_LIBRARY.values()]
+    elif args.scenario:
+        for name in args.scenario:
+            if name not in SCENARIO_LIBRARY:
+                ap.error(
+                    f"unknown scenario {name!r} — choices: "
+                    f"{', '.join(SCENARIO_LIBRARY)}"
+                )
+            specs.append(dict(SCENARIO_LIBRARY[name]))
+    else:
+        ap.error("pick --list, --scenario NAME, --all, or --spec PATH")
+
+    home = args.home or tempfile.mkdtemp(prefix="scenario-run-")
+    reports = []
+    for spec in specs:
+        print(f"=== {spec['name']}: {spec.get('description', '')}")
+        report = ScenarioRunner(home=home).run(spec)
+        reports.append(report)
+        verdict = "PASS" if report["ok"] else "FAIL"
+        print(f"    {verdict} in {report['elapsed_s']}s — {_detail(report)}")
+        for failure in report["failures"]:
+            print(f"    failure: {failure}")
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(reports, fh, indent=2, sort_keys=True)
+        print(f"reports written to {args.json}")
+
+    width = max(len(r["scenario"]) for r in reports)
+    failed = 0
+    print("\nscenario results:")
+    for report in reports:
+        verdict = "PASS" if report["ok"] else "FAIL"
+        print(f"  {report['scenario']:<{width}}  {verdict}  {_detail(report)}")
+        failed += not report["ok"]
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
