@@ -470,6 +470,10 @@ class ConsensusReactor(Reactor):
         # surfaces inside the consensus loop, not here — route it back
         # to the switch's misbehavior scorer by peer id
         self.cs.on_peer_misbehavior = self._report_peer_misbehavior
+        # gossip observatory: the consensus loop stamps redundant
+        # vote/part deliveries and first-seen propagation into the
+        # switch-owned rollup (same wiring seam as misbehavior above)
+        self.cs.gossip = self.switch.gossip if self.switch is not None else None
         es = self.cs.event_switch
         es.add_listener("reactor", ev.EVENT_NEW_ROUND_STEP, self._on_new_round_step)
         es.add_listener("reactor", ev.EVENT_VOTE, self._on_vote_event)

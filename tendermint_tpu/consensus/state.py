@@ -185,6 +185,10 @@ class ConsensusState:
             heightlog if heightlog is not None else _heightlog.HeightLedger()
         )
         self.vote_arrivals = _heightlog.VoteArrivalRollup()
+        # gossip observatory: the switch-owned GossipRollup, wired by
+        # the consensus reactor's on_start (None standalone) — vote/part
+        # duplicate adds and first-seen propagation stamps land here
+        self.gossip = None
         self._last_commit_wall: float | None = None
         self._phase_acc: dict[str, list] = {}  # phase -> [dur_s, work_s]
         self._height_work0 = _heightlog.work_totals()
@@ -707,7 +711,7 @@ class ConsensusState:
                     self.set_proposal_fn(m)
                 else:
                     height, round_, part = m
-                    self._handle_block_part(height, round_, part)
+                    self._handle_block_part(height, round_, part, item.peer_id)
         elif isinstance(item, TimeoutRecord):
             self._handle_timeout(
                 TimeoutInfo(item.duration, item.height, item.round, item.step)
@@ -1153,7 +1157,9 @@ class ConsensusState:
         if self.proposal_block_parts is None:
             self.proposal_block_parts = PartSet.from_header(proposal.block_parts_header)
 
-    def _handle_block_part(self, height: int, round_: int, part: Part) -> None:
+    def _handle_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str = ""
+    ) -> None:
         """Reference `addProposalBlockPart :1282-1315`."""
         if height != self.height or self.proposal_block_parts is None:
             return
@@ -1165,6 +1171,14 @@ class ConsensusState:
             added = self.proposal_block_parts.add_part(part)
         except ValidationError:
             return
+        if self.gossip is not None:
+            if added:
+                self.gossip.first_seen("block_part", height, round_, part.index)
+            elif peer_id:
+                # PartSet already-have part: a peer re-shipped a part we
+                # hold — redundant wire bytes (own enqueues gate out on
+                # the empty peer_id, same rule as votes)
+                self.gossip.redundant("block_part", len(part.encode()))
         if not added or not self.proposal_block_parts.is_complete():
             return
         buf = b"".join(
@@ -1912,6 +1926,11 @@ class ConsensusState:
                         # the commit pacing to gather — start round 0 now
                         # (reference `handleMsg`'s skipTimeoutCommit leg)
                         self._enter_new_round(self.height, 0)
+                elif self.gossip is not None and peer_id:
+                    # catchup precommit we already tallied: the sender
+                    # re-gossiped a known vote (own re-queues have
+                    # peer_id="" and don't count)
+                    self.gossip.redundant("vote", len(vote.encode()))
             return
         if vote.height != self.height:
             return
@@ -1926,7 +1945,18 @@ class ConsensusState:
             vote, peer_id, verifier=self.verifier, preverified=preverified
         )
         if not added:
+            # a VoteSet exact-duplicate add — before the gossip
+            # observatory this wasted wire traffic vanished silently
+            if self.gossip is not None and peer_id:
+                self.gossip.redundant("vote", len(vote.encode()))
             return
+        if self.gossip is not None:
+            # first delivery of this (height, round, validator) vote on
+            # this node: the propagation-map stamp gossip_report merges
+            # across nodes (bounded like VoteArrivalRollup)
+            self.gossip.first_seen(
+                "vote", vote.height, vote.round, vote.validator_index
+            )
         self.event_switch.fire(ev.EVENT_VOTE, ev.EventDataVote(vote))
 
         if vote.type == VOTE_TYPE_PREVOTE:

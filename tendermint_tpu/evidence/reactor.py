@@ -84,7 +84,13 @@ class EvidenceReactor(Reactor):
         evidence = decode_evidence_list(payload)
         for ev in evidence:
             try:
-                self.pool.add_evidence(ev)
+                added = self.pool.add_evidence(ev)
+                if not added and self.switch is not None:
+                    # pool dedup (already pending or already committed):
+                    # the rebroadcast routine re-offers pending batches
+                    # by design, so re-arrivals are common — the gossip
+                    # observatory counts what that retry policy costs
+                    self.switch.gossip.redundant("evidence", len(ev.encode()))
             except ErrEvidenceUnprovable:
                 # offender outside every retained valset (rotation /
                 # max-age horizon): unverifiable here, NOT the relaying

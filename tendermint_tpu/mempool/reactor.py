@@ -82,11 +82,17 @@ class MempoolReactor(Reactor):
         if self.switch is not None:
             from tendermint_tpu.abci.types import CodeType
 
-            switch, peer_id = self.switch, peer.id
+            switch, peer_id, nbytes = self.switch, peer.id, len(payload)
 
-            def cb(res, _switch=switch, _peer_id=peer_id):
+            def cb(res, _switch=switch, _peer_id=peer_id, _nbytes=nbytes):
                 if res.code == CodeType.UNAUTHORIZED:
                     _switch.report_misbehavior(_peer_id, "bad_sig", detail="gossiped tx")
+                elif res.code == CodeType.TX_IN_CACHE:
+                    # gossip observatory: a dup-cache hit on re-arrival
+                    # means a peer shipped a tx we already hold — the
+                    # redundancy counter makes that wasted wire traffic
+                    # visible (local RPC re-submits never reach here)
+                    _switch.gossip.redundant("tx", _nbytes)
 
         submit = getattr(self.mempool, "check_tx_async", None)
         (submit or self.mempool.check_tx)(tx, cb)

@@ -123,10 +123,17 @@ class MConnection:
         ping_interval: float = DEFAULT_PING_INTERVAL,
         pong_timeout: float = DEFAULT_PONG_TIMEOUT,
         local_node_id: str = "",
+        on_traffic=None,
     ) -> None:
         # who WE are, for `p2p.hop` span attribution (the Switch wires
         # its node id through Peer; "" on bare test connections)
         self.local_node_id = local_node_id
+        # gossip observatory hook: on_traffic(direction, chan_id,
+        # payload, frame_len) fires per frame AFTER the wire bytes are
+        # fixed — it observes, never alters (Peer binds the remote id
+        # and forwards into the switch's GossipRollup; None = sampled
+        # out, zero per-frame overhead)
+        self._on_traffic = on_traffic
         # per-connection throughput stats + optional rate caps
         # (reference flowrate.Monitor at p2p/connection.go:72-73)
         self.send_monitor = Monitor(send_limit)
@@ -264,6 +271,8 @@ class MConnection:
                 # process-wide throughput counter alongside the per-peer
                 # monitor (rates come from the monitors at scrape time)
                 _metrics.P2P_SENT_BYTES.inc(len(frame))
+                if self._on_traffic is not None:
+                    self._on_traffic("send", ch.desc.id, payload, len(frame))
                 ch.recently_sent += len(payload)
         except EndpointClosed:
             self._die(None)
@@ -300,6 +309,8 @@ class MConnection:
                     self._die(PeerMisbehavior("bad_frame", str(e)))
                     return
                 self._last_recv = time.monotonic()
+                if self._on_traffic is not None:
+                    self._on_traffic("recv", chan_id, payload, len(frame))
                 if chan_id == CTRL_CHANNEL:
                     # keepalive (reference recvRoutine ping/pong handling
                     # `p2p/connection.go:412-425`): answer pings; any pong

@@ -83,6 +83,7 @@ class Peer:
         ping_interval: float | None = None,
         pong_timeout: float | None = None,
         local_node_id: str = "",
+        gossip=None,
     ) -> None:
         self.node_info = node_info
         self.outbound = outbound
@@ -92,6 +93,16 @@ class Peer:
             kw["ping_interval"] = ping_interval
         if pong_timeout is not None:
             kw["pong_timeout"] = pong_timeout
+        if gossip is not None and gossip.enabled:
+            # gossip observatory: bind OUR view of the remote id to every
+            # frame the connection sees (disabled rollup -> no hook at
+            # all, the sampled-out fast path)
+            remote = node_info.node_id
+            kw["on_traffic"] = (
+                lambda direction, chan_id, payload, frame_len: gossip.record(
+                    remote, direction, chan_id, payload, frame_len
+                )
+            )
         self._conn = MConnection(
             endpoint,
             channels,

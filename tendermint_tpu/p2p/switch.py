@@ -18,6 +18,7 @@ from tendermint_tpu.p2p.peer import NodeInfo, Peer
 from tendermint_tpu.p2p.score import PeerMisbehavior, PeerScorer
 from tendermint_tpu.p2p.transport import Endpoint, pipe_pair
 from tendermint_tpu.telemetry import metrics as _metrics
+from tendermint_tpu.telemetry.gossiplog import GossipRollup
 from tendermint_tpu.utils.lockrank import ranked_rlock
 from tendermint_tpu.utils.log import kv, logger
 
@@ -90,6 +91,11 @@ class Switch:
         # offenses through report_misbehavior; crossing the threshold
         # disconnects AND refuses reconnection until the ban decays.
         self.scorer = PeerScorer()
+        # gossip observatory (telemetry/gossiplog.py): per-peer/channel/
+        # kind traffic + redundancy rollup. Owned here so every peer's
+        # connection and every reactor's dedup site stamp ONE table;
+        # TENDERMINT_TPU_GOSSIPLOG=0 samples the whole node out.
+        self.gossip = GossipRollup()
 
     @property
     def node_info(self) -> NodeInfo:
@@ -204,6 +210,7 @@ class Switch:
                 ping_interval=self.ping_interval,
                 pong_timeout=self.pong_timeout,
                 local_node_id=self._base_info.node_id,
+                gossip=self.gossip,
             )
             self._peers[remote_info.node_id] = peer
         # Reactors install their per-peer state BEFORE the recv loop

@@ -270,6 +270,7 @@ def make_routes(node) -> dict:
         heights: int = 0,
         profile: int = 0,
         launches: int = 0,
+        gossip: int = 0,
     ) -> dict:
         """Structured telemetry dump: the full metrics registry, the
         recent span window (consensus round phases, device dispatch),
@@ -285,7 +286,10 @@ def make_routes(node) -> dict:
         snapshot + top-contended locks + unified queue waits —
         `tools/contention_report.py` consumes it); `launches` > 0
         returns the last N LaunchLedger records + per-kind rollup (the
-        device observatory — `tools/device_report.py` consumes it).
+        device observatory — `tools/device_report.py` consumes it);
+        `gossip` > 0 returns the gossip observatory view (per-peer ×
+        per-channel × per-kind traffic, redundancy counters, first-seen
+        propagation stamps — `tools/gossip_report.py` consumes it).
 
         High-cardinality detail (per-peer, per-thread, per-site) is
         served ONLY here, through `telemetry/views.py` — the dump-only
@@ -322,6 +326,8 @@ def make_routes(node) -> dict:
             want.append("profile")
         if int(launches) > 0:
             want.append(("launches", {"n": int(launches)}))
+        if int(gossip) > 0:
+            want.append("gossip")
         out.update(views.collect(node, want))
         if int(flight) > 0:
             from tendermint_tpu.telemetry.flightrec import FLIGHT

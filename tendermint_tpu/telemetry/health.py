@@ -226,6 +226,23 @@ def _serving_section(node) -> dict | None:
     return out
 
 
+def _gossip_section(node) -> dict | None:
+    """Gossip observatory headline (telemetry/gossiplog.py): the top
+    redundant message kind and the hottest channel by bytes. REPORTED,
+    never folded — over-gossip wastes bandwidth, it doesn't make a node
+    unready (scenario expectations and the bench floor are where
+    redundancy bounds get enforced). None without a switch (harness
+    stubs) or with the rollup sampled out."""
+    gossip = getattr(getattr(node, "switch", None), "gossip", None)
+    if gossip is None:
+        return None
+    try:
+        out = gossip.headline()
+    except Exception:
+        return None
+    return out if out.get("enabled") else None
+
+
 def build_health(node, ledger=None) -> dict:
     """The health snapshot for one composed node (`node.Node` or
     anything duck-typed close enough — every read is getattr-tolerant,
@@ -355,4 +372,10 @@ def build_health(node, ledger=None) -> dict:
     serving = _serving_section(node)
     if serving is not None:
         out["serving"] = serving
+    # gossip observatory headline (reported, never folded): top
+    # redundant kind + hottest channel — the full tables are dump-only
+    # (`dump_telemetry?gossip=1`).
+    gossip = _gossip_section(node)
+    if gossip is not None:
+        out["gossip"] = gossip
     return out

@@ -643,6 +643,100 @@ for _kind in (
 ):
     PEER_MISBEHAVIOR.labels(kind=_kind).inc(0)
 
+# -- gossip observatory (telemetry/gossiplog.py) ------------------------------
+#
+# Per-channel bandwidth attribution and duplicate-delivery redundancy.
+# `channel` and `kind` are the FIXED wire vocabularies below — the
+# channel-id map and first-byte message tags mirrored from the reactors
+# by gossiplog.py (unknown -> "other"), never peer ids or heights.
+# Per-peer tables and first-seen propagation stamps are dump-only
+# (`dump_telemetry?gossip=1`); tools/gossip_report.py merges them
+# across nodes.
+
+GOSSIP_CHANNELS = (
+    "pex",
+    "cns_state",
+    "cns_data",
+    "cns_vote",
+    "cns_votebits",
+    "mempool",
+    "evidence",
+    "blockchain",
+    "statesync",
+    "lightclient",
+    "ctrl",
+    "other",
+)
+GOSSIP_KINDS = (
+    "pex_request",
+    "pex_addrs",
+    "new_round_step",
+    "commit_step",
+    "proposal",
+    "proposal_pol",
+    "block_part",
+    "vote",
+    "has_vote",
+    "vote_set_maj23",
+    "vote_set_bits",
+    "proposal_heartbeat",
+    "tx",
+    "evidence_list",
+    "block_request",
+    "block_response",
+    "no_block",
+    "status_request",
+    "status_response",
+    "snapshots_request",
+    "snapshots_response",
+    "chunk_request",
+    "chunk_response",
+    "no_chunk",
+    "commit_request",
+    "commit_response",
+    "fc_request",
+    "fc_response",
+    "fc_subscribe",
+    "fc_announce",
+    "ping",
+    "pong",
+    "other",
+)
+# The silent-dedup taxonomy: kinds whose duplicate deliveries used to
+# vanish (VoteSet exact-dup adds, PartSet already-have parts, mempool
+# dup-cache hits on re-arrival, evidence-pool re-offers).
+GOSSIP_REDUNDANT_KINDS = ("vote", "block_part", "tx", "evidence")
+
+P2P_CHANNEL_BYTES = Counter(
+    "tendermint_p2p_channel_bytes_total",
+    "Frame bytes by p2p channel and direction (send/recv)",
+    labelnames=("channel", "dir"),
+)
+GOSSIP_MSGS = Counter(
+    "tendermint_gossip_msgs_total",
+    "Gossip messages by wire kind and direction (send/recv)",
+    labelnames=("kind", "dir"),
+)
+GOSSIP_REDUNDANT = Counter(
+    "tendermint_gossip_redundant_total",
+    "Duplicate gossip deliveries dedup'd after arrival, by kind",
+    labelnames=("kind",),
+)
+GOSSIP_REDUNDANT_BYTES = Counter(
+    "tendermint_gossip_redundant_bytes_total",
+    "Payload bytes of duplicate gossip deliveries, by kind",
+    labelnames=("kind",),
+)
+
+for _dir in ("send", "recv"):
+    for _chan in GOSSIP_CHANNELS:
+        P2P_CHANNEL_BYTES.labels(channel=_chan, dir=_dir).inc(0)
+    for _kind in GOSSIP_KINDS:
+        GOSSIP_MSGS.labels(kind=_kind, dir=_dir).inc(0)
+for _kind in GOSSIP_REDUNDANT_KINDS:
+    GOSSIP_REDUNDANT.labels(kind=_kind).inc(0)
+    GOSSIP_REDUNDANT_BYTES.labels(kind=_kind).inc(0)
+
 # -- WAN link chaos + scenario engine (p2p/transport.py, testing/) ------------
 #
 # `result` on the link-send counter is the fixed delivery taxonomy of

@@ -95,6 +95,28 @@ def _vote_arrivals_view(node) -> dict | None:
     return arrivals.snapshot()
 
 
+@view("gossip")
+def _gossip_view(node) -> dict | None:
+    """The gossip observatory (opt-in, `gossip=1`): the switch-owned
+    GossipRollup snapshot — per-peer × per-channel × per-kind traffic
+    tables, per-kind redundancy counters, and first-seen propagation
+    stamps. Per-peer and per-height detail lives ONLY here (dump-only
+    cardinality); `tools/gossip_report.py` merges dumps across nodes
+    into the bandwidth waterfall + propagation matrix."""
+    gossip = getattr(getattr(node, "switch", None), "gossip", None)
+    if gossip is None:
+        return None
+    snap = gossip.snapshot()
+    snap["redundancy_factor"] = gossip.redundancy_factors()
+    # join the consensus node id so cross-node merges can label rows
+    # even when dumps are collected from files rather than RPC
+    info = getattr(getattr(node, "switch", None), "node_info", None)
+    if info is not None:
+        snap["node_id"] = info.node_id
+        snap["moniker"] = info.moniker
+    return snap
+
+
 @view("launches")
 def _launches_view(node, n: int = 128) -> dict:
     """The device observatory (opt-in, `launches=N`): the newest N

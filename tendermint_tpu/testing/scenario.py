@@ -549,6 +549,56 @@ class ScenarioRunner:
                         p = topo.profile(i, j)
                         worst = max(worst, p.rtt_ms / 2.0 / 1000.0 * topo.scale)
             report["max_one_way_delay_s"] = round(worst, 4)
+        gsum = self._gossip_summary(net)
+        if gsum is not None:
+            report["gossip"] = gsum
+
+    @staticmethod
+    def _gossip_summary(net) -> dict | None:
+        """Fleet-wide gossip observatory rollup: per-channel bytes,
+        per-kind redundancy factor (delivered / useful), top redundant
+        kind — the numbers the `expect.gossip` block grades and the
+        scenario_run/nemesis_demo verdict tables print. None when every
+        node is sampled out (TENDERMINT_TPU_GOSSIPLOG=0)."""
+        chans: dict[str, int] = {}
+        kinds_recv: dict[str, int] = {}
+        red: dict[str, dict] = {}
+        seen = False
+        for node in net.nodes:
+            gossip = getattr(getattr(node, "switch", None), "gossip", None)
+            if gossip is None or not gossip.enabled:
+                continue
+            seen = True
+            snap = gossip.snapshot()
+            for c, st in snap["channels"].items():
+                chans[c] = chans.get(c, 0) + st["send_bytes"] + st["recv_bytes"]
+            for k, st in snap["kinds"].items():
+                kinds_recv[k] = kinds_recv.get(k, 0) + st["recv_msgs"]
+            for k, st in snap["redundant"].items():
+                r = red.setdefault(k, {"msgs": 0, "bytes": 0})
+                r["msgs"] += st["msgs"]
+                r["bytes"] += st["bytes"]
+        if not seen:
+            return None
+        # redundant-kind -> wire-kind join (evidence dedups per item,
+        # the wire ships lists)
+        kind_of = {"evidence": "evidence_list"}
+        factors: dict[str, float] = {}
+        for k, r in red.items():
+            recv = kinds_recv.get(kind_of.get(k, k), 0)
+            useful = recv - r["msgs"]
+            if useful > 0:
+                factors[k] = round(recv / useful, 3)
+            elif r["msgs"]:
+                factors[k] = float(r["msgs"] + 1)
+        top = max(red.items(), key=lambda kv: kv[1]["bytes"], default=None)
+        return {
+            "channel_bytes": chans,
+            "redundant": red,
+            "redundancy_factor": factors,
+            "top_redundant_kind": top[0] if top else None,
+            "total_bytes": sum(chans.values()),
+        }
 
     def _grade(self, net, spec, report: dict) -> None:
         exp = spec["expect"]
@@ -603,6 +653,36 @@ class ScenarioRunner:
                     f"round skips after warmup: {post} > "
                     f"{exp['max_round_skips_post_warm']} (timeouts thrashing)"
                 )
+        gexp = exp.get("gossip") or {}
+        if gexp:
+            # bandwidth/redundancy assertions graded from the gossip
+            # observatory rollups (docs/SCENARIOS.md "expect.gossip") —
+            # WAN scenarios bound gossip amplification the same way they
+            # bound finality
+            g = report.get("gossip")
+            if g is None:
+                fails.append(
+                    "gossip expectations set but no rollup collected "
+                    "(TENDERMINT_TPU_GOSSIPLOG sampled out?)"
+                )
+            else:
+                if gexp.get("require_counted") and g["total_bytes"] <= 0:
+                    fails.append("gossip accounting counted zero bytes")
+                for kind, cap in (gexp.get("max_redundancy") or {}).items():
+                    got = g["redundancy_factor"].get(kind)
+                    if got is not None and got > cap:
+                        fails.append(
+                            f"gossip redundancy {kind} {got}x > {cap}x"
+                        )
+                for chan, cap_mb in (
+                    gexp.get("max_channel_mbytes") or {}
+                ).items():
+                    got_mb = g["channel_bytes"].get(chan, 0) / 1e6
+                    if got_mb > cap_mb:
+                        fails.append(
+                            f"gossip channel {chan} "
+                            f"{got_mb:.2f} MB > {cap_mb} MB"
+                        )
         report["ok"] = not fails
 
 
@@ -666,6 +746,11 @@ SCENARIO_LIBRARY: dict[str, dict] = {
             "warm_height": 18,
             "adaptive_above_max_delay": True,
             "max_round_skips_post_warm": 0,
+            # gossip amplification bound: a 4-peer full mesh re-gossips
+            # every vote to every peer, so each node hears each vote up
+            # to ~3x (n-1); 12x means the push-gossip layer is looping
+            "gossip": {"require_counted": True,
+                       "max_redundancy": {"vote": 12.0}},
         },
         "slow": False,
     },
@@ -689,6 +774,10 @@ SCENARIO_LIBRARY: dict[str, dict] = {
             "min_epochs": 3,
             "min_valset_rebuilds": 3,
             "bisection_bridges": True,
+            # churn re-gossips votes across epoch boundaries; bound the
+            # amplification but leave headroom for rotation catchup
+            "gossip": {"require_counted": True,
+                       "max_redundancy": {"vote": 16.0}},
         },
         "slow": False,
     },
@@ -722,7 +811,15 @@ SCENARIO_LIBRARY: dict[str, dict] = {
             {"at_height": 20, "action": "load_rate", "rate": 25.0},
         ],
         "run": {"target_height": 30, "timeout_s": 180.0},
-        "expect": {"min_height": 30, "max_finality_p95_s": 3.0},
+        "expect": {
+            "min_height": 30,
+            "max_finality_p95_s": 3.0,
+            # the burst must not amplify: tx redundancy (peers cross-
+            # shipping txs the dup-cache already holds) stays bounded
+            # even at 6x load, and vote gossip holds the mesh bound
+            "gossip": {"require_counted": True,
+                       "max_redundancy": {"vote": 12.0, "tx": 30.0}},
+        },
         "slow": True,
     },
     "regional_outage": {
@@ -742,7 +839,13 @@ SCENARIO_LIBRARY: dict[str, dict] = {
             {"at_height": 16, "action": "heal"},
         ],
         "run": {"target_height": 24, "timeout_s": 180.0},
-        "expect": {"min_height": 24},
+        "expect": {
+            "min_height": 24,
+            # the healed region replays missed votes/parts on rejoin —
+            # redundancy spikes by design, but must stay finite
+            "gossip": {"require_counted": True,
+                       "max_redundancy": {"vote": 24.0}},
+        },
         "slow": True,
     },
     "churn_storm": {

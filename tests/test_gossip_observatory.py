@@ -1,0 +1,288 @@
+"""Gossip observatory integration: the duplicate-delivery live net,
+the dump/health surfaces, and the cross-node report tool.
+
+The headline test is the ISSUE's acceptance shape in miniature: a
+4-node net where chaos re-delivers every frame twice. The dedup sites
+(VoteSet duplicate adds, PartSet already-have parts) swallow the
+copies exactly as before — consensus output is identical across nodes,
+no fork — but `tendermint_gossip_redundant_total` now *counts* them,
+and the per-kind redundancy factor reads > 1.0.
+"""
+
+import json
+import time
+from types import SimpleNamespace
+
+from tendermint_tpu.p2p import NodeInfo
+from tendermint_tpu.telemetry import views
+from tendermint_tpu.telemetry.gossiplog import GossipRollup
+from tendermint_tpu.telemetry.health import _gossip_section, build_health
+from tendermint_tpu.telemetry.heightlog import HeightLedger
+from tendermint_tpu.testing.nemesis import Nemesis
+
+from tools.gossip_report import build_report, load_dumps, render_text
+
+
+class TestDuplicateDeliveryNet:
+    def test_duplicated_links_count_redundancy_without_forking(self, tmp_path):
+        """Every link delivers every frame twice (dup_prob=1.0): the
+        exact duplicates hit the silent dedup sites, the redundant
+        counters advance, the redundancy factor clears 1.0 — and the
+        committed chain is byte-identical on every node."""
+        with Nemesis(4, home=str(tmp_path)) as net:
+            net.wait_height(2, timeout=60)
+            for i in range(4):
+                for j in range(i + 1, 4):
+                    net.duplicate(i, j, 1.0)
+            target = max(net.heights()) + 3
+            net.wait_height(target, timeout=90)
+
+            red = {}
+            for node in net.nodes:
+                snap = node.switch.gossip.snapshot()
+                for kind, st in snap["redundant"].items():
+                    red[kind] = red.get(kind, 0) + st["msgs"]
+            assert red.get("vote", 0) > 0, f"no redundant votes: {red}"
+            # dup'd frames carry real bytes, and at least one node's
+            # vote factor shows the 2x-delivery wastage
+            factors = [
+                node.switch.gossip.redundancy_factors().get("vote", 0.0)
+                for node in net.nodes
+            ]
+            assert max(factors) > 1.0, f"factors: {factors}"
+
+            # consensus output unaffected: no fork, and the block bytes
+            # agree across all four stores at every shared height
+            net.check_invariants()
+            common = min(net.heights())
+            for h in range(1, common + 1):
+                blocks = {
+                    bytes(node.store.load_block(h).encode())
+                    for node in net.nodes
+                }
+                assert len(blocks) == 1, f"stores disagree at h{h}"
+
+
+def _gossip_with_traffic() -> GossipRollup:
+    g = GossipRollup(enabled=True)
+    for i in range(6):
+        g.record("ab" * 20, "recv", 0x22, b"\x06" + b"v" * 80, 90)
+    g.record("ab" * 20, "send", 0x21, b"\x05" + b"p" * 300, 310)
+    g.redundant("vote", 90)
+    g.redundant("vote", 90)
+    g.first_seen("vote", 7, 0, 1)
+    return g
+
+
+def _stub_node(gossip=None):
+    ledger = HeightLedger()
+    now = time.time()
+    for h in (1, 2, 3):
+        ledger.record(
+            {"height": h, "finality_s": 0.2 if h > 1 else None, "t_commit": now}
+        )
+    switch = SimpleNamespace(
+        n_peers=lambda: 3,
+        node_info=NodeInfo("s" * 40, "stub-moniker", "stub-chain"),
+    )
+    if gossip is not None:
+        switch.gossip = gossip
+    return SimpleNamespace(
+        node_id="stub",
+        consensus=SimpleNamespace(
+            verifier=SimpleNamespace(snapshot=lambda: {"state": "closed"}),
+            fatal_error=None,
+        ),
+        blockchain_reactor=SimpleNamespace(fast_sync=False),
+        statesync_reactor=None,
+        switch=switch,
+        block_store=SimpleNamespace(height=3),
+        hasher=None,
+        height_ledger=ledger,
+    )
+
+
+class TestDumpView:
+    def test_gossip_view_joins_node_identity(self):
+        node = _stub_node(gossip=_gossip_with_traffic())
+        out = views.collect(node, ["gossip"])
+        view = out["gossip"]
+        assert view["node_id"] == "s" * 40
+        assert view["moniker"] == "stub-moniker"
+        assert view["kinds"]["vote"]["recv_msgs"] == 6
+        assert view["redundant"]["vote"]["msgs"] == 2
+        assert view["redundancy_factor"]["vote"] == 1.5  # 6 / (6-2)
+        assert "vote/7/0/1" in view["first_seen"]
+
+    def test_view_omitted_without_rollup(self):
+        node = _stub_node(gossip=None)
+        assert "gossip" not in views.collect(node, ["gossip"])
+
+    def test_view_is_json_serializable(self):
+        node = _stub_node(gossip=_gossip_with_traffic())
+        json.dumps(views.collect(node, ["gossip"]))
+
+
+class TestHealthSection:
+    def test_headline_reported_not_folded(self):
+        node = _stub_node(gossip=_gossip_with_traffic())
+        h = build_health(node)
+        assert h["status"] == "ok" and h["ready"]  # never folds status
+        assert h["gossip"]["top_redundant_kind"] == "vote"
+        assert h["gossip"]["hottest_channel"] == "cns_vote"
+
+    def test_section_absent_when_sampled_out(self):
+        assert _gossip_section(_stub_node(gossip=None)) is None
+        node = _stub_node(gossip=GossipRollup(enabled=False))
+        assert _gossip_section(node) is None
+        h = build_health(node)
+        assert "gossip" not in h
+
+
+def _synthetic_view(node_id, moniker, recv_votes, red_votes, stamps):
+    g = GossipRollup(enabled=True)
+    for _ in range(recv_votes):
+        g.record("peer" + node_id, "recv", 0x22, b"\x06v", 90)
+    for _ in range(red_votes):
+        g.redundant("vote", 90)
+    view = g.snapshot()
+    # deterministic cross-node stamps (the live path uses time.time())
+    view["first_seen"] = stamps
+    view["node_id"] = node_id
+    view["moniker"] = moniker
+    return view
+
+
+class TestReportTool:
+    def _views(self):
+        # vote v at h5 originates on node a (t=100.0) and propagates:
+        # b +30ms, c +80ms; part p reaches only b (+10ms)
+        return [
+            _synthetic_view("a" * 40, "node-a", 10, 4,
+                            {"vote/5/0/1": 100.0, "block_part/5/0/0": 100.0}),
+            _synthetic_view("b" * 40, "node-b", 10, 2,
+                            {"vote/5/0/1": 100.03, "block_part/5/0/0": 100.01}),
+            _synthetic_view("c" * 40, "node-c", 12, 0,
+                            {"vote/5/0/1": 100.08}),
+        ]
+
+    def test_waterfall_redundancy_and_propagation(self):
+        report = build_report(
+            self._views(), placement=["us-east", "us-west", "eu-west"]
+        )
+        assert report["nodes"] == 3
+        assert report["regions"] == ["us-east", "us-west", "eu-west"]
+        # waterfall: 32 recv vote frames x 90B
+        assert report["channels"]["cns_vote"]["recv_bytes"] == 32 * 90
+        # redundancy ranking: 32 delivered, 6 dup'd -> 32/26
+        vote = report["redundancy"]["vote"]
+        assert vote["redundant_msgs"] == 6
+        assert vote["factor"] == round(32 / 26, 3)
+        # propagation: origin us-east, deltas in ms
+        prop = report["propagation"]
+        assert prop["us-east->us-west"]["n"] == 2  # vote + part
+        assert abs(prop["us-east->us-west"]["mean_ms"] - 20.0) < 0.5
+        assert abs(prop["us-east->eu-west"]["mean_ms"] - 80.0) < 0.5
+        assert report["propagation_keys_merged"] == 2
+
+    def test_verdict_names_top_waste_with_roadmap_fix(self):
+        report = build_report(self._views())
+        v = report["verdict"]
+        assert v["top_waste_source"] == "vote_redundancy"
+        assert v["cost_bytes"] == 6 * 90
+        assert "item 3" in v["fix_first"]
+
+    def test_verdict_falls_back_to_hottest_channel(self):
+        g = GossipRollup(enabled=True)
+        g.record("p" * 40, "recv", 0x21, b"\x05part", 5000)
+        view = g.snapshot()
+        report = build_report([view])
+        assert report["verdict"]["top_waste_source"] == "data_bandwidth"
+
+    def test_render_text_is_complete(self):
+        report = build_report(
+            self._views(), placement=["us-east", "us-west", "eu-west"]
+        )
+        text = render_text(report)
+        assert "cns_vote" in text
+        assert "vote" in text
+        assert "us-east->us-west" in text or "us-east -> us-west" in text
+        assert "vote_redundancy" in text
+
+    def test_load_dumps_accepts_all_shapes(self, tmp_path):
+        bare = self._views()[0]
+        wrapped = {"gossip": self._views()[1]}
+        rpc = {"result": {"gossip": self._views()[2]}}
+        for name, payload in [
+            ("bare.json", bare), ("wrapped.json", wrapped), ("rpc.json", rpc)
+        ]:
+            (tmp_path / name).write_text(json.dumps(payload))
+        (tmp_path / "junk.json").write_text("not json {")
+        loaded = load_dumps([str(tmp_path / "*.json")])
+        assert len(loaded) == 3
+        assert all("channels" in v and "redundant" in v for v in loaded)
+
+
+class TestScenarioGrading:
+    """The expect.gossip schema (docs/SCENARIOS.md) graded against a
+    synthetic report — the seams scenario specs use to bound gossip
+    amplification alongside finality."""
+
+    def _graded(self, gossip_summary, gexp):
+        from tendermint_tpu.testing.scenario import ScenarioRunner
+
+        runner = ScenarioRunner.__new__(ScenarioRunner)
+        report = {
+            "heights": [5, 5, 5, 5],
+            "failures": [],
+            "finality": {},
+            "gossip": gossip_summary,
+        }
+        spec = {
+            "expect": {"min_height": 1, "gossip": gexp},
+            "run": {"target_height": 1},
+        }
+        net = SimpleNamespace(
+            check_invariants=lambda: None,
+            nodes=[SimpleNamespace(running=True)] * 4,
+        )
+        runner._grade(net, spec, report)
+        return report
+
+    def _summary(self, **over):
+        base = {
+            "channel_bytes": {"cns_vote": 2_000_000, "mempool": 500_000},
+            "redundant": {"vote": {"msgs": 10, "bytes": 900}},
+            "redundancy_factor": {"vote": 2.0},
+            "top_redundant_kind": "vote",
+            "total_bytes": 2_500_000,
+        }
+        base.update(over)
+        return base
+
+    def test_within_bounds_passes(self):
+        report = self._graded(
+            self._summary(),
+            {"require_counted": True, "max_redundancy": {"vote": 4.0},
+             "max_channel_mbytes": {"cns_vote": 10.0}},
+        )
+        assert report["ok"], report["failures"]
+
+    def test_redundancy_cap_fails(self):
+        report = self._graded(
+            self._summary(), {"max_redundancy": {"vote": 1.5}}
+        )
+        assert not report["ok"]
+        assert any("redundancy vote" in f for f in report["failures"])
+
+    def test_channel_budget_fails(self):
+        report = self._graded(
+            self._summary(), {"max_channel_mbytes": {"cns_vote": 1.0}}
+        )
+        assert not report["ok"]
+        assert any("channel cns_vote" in f for f in report["failures"])
+
+    def test_missing_rollup_fails_when_expected(self):
+        report = self._graded(None, {"require_counted": True})
+        assert not report["ok"]
+        assert any("no rollup" in f for f in report["failures"])

@@ -1,0 +1,419 @@
+"""Gossip observatory unit coverage (telemetry/gossiplog.py).
+
+Four properties the observatory must hold:
+
+* the static classification tables mirror the reactors' own wire
+  constants (drift fails here, not as silent "other" classification);
+* the rollup tables are bounded no matter what a byzantine peer sends
+  (peer-row overflow folds, first-seen heights evict oldest-first);
+* accounting never changes the wire — frames are byte-identical with
+  the hook installed vs sampled out (the golden-bytes test), and an
+  instrumented switch interoperates with a TENDERMINT_TPU_GOSSIPLOG=0
+  one;
+* the redundancy-factor arithmetic (delivered/useful) is exact.
+"""
+
+import queue
+import threading
+import time
+
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    MConnection,
+    NodeInfo,
+    Reactor,
+    Switch,
+    connect_switches,
+    pipe_pair,
+)
+from tendermint_tpu.telemetry.gossiplog import (
+    CHANNEL_NAMES,
+    KIND_TAGS,
+    GossipRollup,
+    channel_name,
+    classify,
+    enabled_from_env,
+)
+
+
+def wait_until(pred, timeout=5.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestKindTablesMatchReactors:
+    """The one static table in gossiplog.py vs the constants every
+    reactor actually writes on the wire. A new message type or a
+    renumbered channel must show up here, or it gets classified
+    "other" in every dump."""
+
+    def test_channel_ids_match_reactors(self):
+        from tendermint_tpu.blockchain.reactor import BLOCKCHAIN_CHANNEL
+        from tendermint_tpu.consensus.reactor import (
+            DATA_CHANNEL,
+            STATE_CHANNEL,
+            VOTE_CHANNEL,
+            VOTE_SET_BITS_CHANNEL,
+        )
+        from tendermint_tpu.evidence.reactor import EVIDENCE_CHANNEL
+        from tendermint_tpu.lightclient.reactor import LIGHTCLIENT_CHANNEL
+        from tendermint_tpu.mempool.reactor import MEMPOOL_CHANNEL
+        from tendermint_tpu.p2p.connection import CTRL_CHANNEL
+        from tendermint_tpu.p2p.pex import PEX_CHANNEL
+        from tendermint_tpu.statesync.reactor import STATESYNC_CHANNEL
+
+        expected = {
+            PEX_CHANNEL: "pex",
+            STATE_CHANNEL: "cns_state",
+            DATA_CHANNEL: "cns_data",
+            VOTE_CHANNEL: "cns_vote",
+            VOTE_SET_BITS_CHANNEL: "cns_votebits",
+            MEMPOOL_CHANNEL: "mempool",
+            EVIDENCE_CHANNEL: "evidence",
+            BLOCKCHAIN_CHANNEL: "blockchain",
+            STATESYNC_CHANNEL: "statesync",
+            LIGHTCLIENT_CHANNEL: "lightclient",
+            CTRL_CHANNEL: "ctrl",
+        }
+        assert CHANNEL_NAMES == expected
+
+    def test_message_tags_match_reactors(self):
+        import tendermint_tpu.blockchain.reactor as bc
+        import tendermint_tpu.consensus.reactor as cns
+        import tendermint_tpu.evidence.reactor as evr
+        import tendermint_tpu.lightclient.reactor as lc
+        import tendermint_tpu.mempool.reactor as mp
+        import tendermint_tpu.p2p.connection as conn
+        import tendermint_tpu.p2p.pex as pex
+        import tendermint_tpu.statesync.reactor as ss
+
+        expected = {
+            pex.PEX_CHANNEL: {
+                pex._MSG_REQUEST: "pex_request",
+                pex._MSG_ADDRS: "pex_addrs",
+            },
+            cns.STATE_CHANNEL: {
+                cns._MSG_NEW_ROUND_STEP: "new_round_step",
+                cns._MSG_COMMIT_STEP: "commit_step",
+                cns._MSG_HAS_VOTE: "has_vote",
+                cns._MSG_VOTE_SET_MAJ23: "vote_set_maj23",
+                cns._MSG_PROPOSAL_HEARTBEAT: "proposal_heartbeat",
+            },
+            cns.DATA_CHANNEL: {
+                cns._MSG_PROPOSAL: "proposal",
+                cns._MSG_PROPOSAL_POL: "proposal_pol",
+                cns._MSG_BLOCK_PART: "block_part",
+            },
+            cns.VOTE_CHANNEL: {cns._MSG_VOTE: "vote"},
+            cns.VOTE_SET_BITS_CHANNEL: {
+                cns._MSG_VOTE_SET_BITS: "vote_set_bits"
+            },
+            mp.MEMPOOL_CHANNEL: {mp._MSG_TX: "tx"},
+            evr.EVIDENCE_CHANNEL: {evr._MSG_EVIDENCE_LIST: "evidence_list"},
+            bc.BLOCKCHAIN_CHANNEL: {
+                bc._MSG_BLOCK_REQUEST: "block_request",
+                bc._MSG_BLOCK_RESPONSE: "block_response",
+                bc._MSG_NO_BLOCK: "no_block",
+                bc._MSG_STATUS_REQUEST: "status_request",
+                bc._MSG_STATUS_RESPONSE: "status_response",
+            },
+            ss.STATESYNC_CHANNEL: {
+                ss._MSG_SNAPSHOTS_REQUEST: "snapshots_request",
+                ss._MSG_SNAPSHOTS_RESPONSE: "snapshots_response",
+                ss._MSG_CHUNK_REQUEST: "chunk_request",
+                ss._MSG_CHUNK_RESPONSE: "chunk_response",
+                ss._MSG_NO_CHUNK: "no_chunk",
+                ss._MSG_COMMIT_REQUEST: "commit_request",
+                ss._MSG_COMMIT_RESPONSE: "commit_response",
+            },
+            lc.LIGHTCLIENT_CHANNEL: {
+                lc._MSG_FC_REQUEST: "fc_request",
+                lc._MSG_FC_RESPONSE: "fc_response",
+                lc._MSG_FC_SUBSCRIBE: "fc_subscribe",
+                lc._MSG_FC_ANNOUNCE: "fc_announce",
+            },
+            conn.CTRL_CHANNEL: {
+                conn._PING[0]: "ping",
+                conn._PONG[0]: "pong",
+            },
+        }
+        assert KIND_TAGS == expected
+
+    def test_kind_vocabulary_is_cataloged(self):
+        """Every kind the classifier can emit must be a pre-seeded label
+        value of tendermint_gossip_msgs_total (bounded cardinality by
+        construction)."""
+        from tendermint_tpu.telemetry.metrics import (
+            GOSSIP_CHANNELS,
+            GOSSIP_KINDS,
+        )
+
+        kinds = {k for tags in KIND_TAGS.values() for k in tags.values()}
+        assert kinds | {"other"} == set(GOSSIP_KINDS)
+        names = set(CHANNEL_NAMES.values())
+        assert names | {"other"} == set(GOSSIP_CHANNELS)
+
+    def test_classify_unknowns_stay_bounded(self):
+        assert classify(0x22, b"\x06rest") == "vote"
+        assert classify(0x22, b"\x07rest") == "other"  # unknown tag
+        assert classify(0x99, b"\x01") == "other"  # unknown channel
+        assert classify(0x22, b"") == "other"  # empty payload
+        assert channel_name(0x30) == "mempool"
+        assert channel_name(0x99) == "other"
+
+
+class TestRollupBounds:
+    def test_peer_rows_fold_into_overflow(self):
+        g = GossipRollup(enabled=True)
+        for i in range(GossipRollup.MAX_PEERS + 10):
+            g.record(f"peer{i}", "recv", 0x22, b"\x06v", 64)
+        snap = g.snapshot()
+        assert len(snap["peers"]) == GossipRollup.MAX_PEERS + 1
+        over = snap["peers"][GossipRollup._OVERFLOW]
+        assert over["cns_vote/vote/recv"] == [10, 640]
+        # aggregates still see every frame
+        assert snap["channels"]["cns_vote"]["recv_msgs"] == (
+            GossipRollup.MAX_PEERS + 10
+        )
+
+    def test_first_seen_evicts_oldest_height(self):
+        g = GossipRollup(enabled=True)
+        for h in range(1, GossipRollup.MAX_FIRST_HEIGHTS + 3):
+            g.first_seen("vote", h, 0, 0)
+        snap = g.snapshot()
+        heights = {int(k.split("/")[1]) for k in snap["first_seen"]}
+        assert len(heights) == GossipRollup.MAX_FIRST_HEIGHTS
+        assert min(heights) == 3  # 1 and 2 evicted
+        # older than the whole retained window: dropped, no eviction
+        g.first_seen("vote", 1, 0, 0)
+        assert len(g.snapshot()["first_seen"]) == len(snap["first_seen"])
+
+    def test_first_seen_per_height_cap(self):
+        g = GossipRollup(enabled=True)
+        g.MAX_FIRST_PER_HEIGHT = 4
+        for i in range(10):
+            g.first_seen("vote", 5, 0, i)
+        assert len(g.snapshot()["first_seen"]) == 4
+
+    def test_first_seen_earliest_stamp_wins(self):
+        g = GossipRollup(enabled=True)
+        g.first_seen("vote", 5, 0, 1)
+        t0 = g.snapshot()["first_seen"]["vote/5/0/1"]
+        time.sleep(0.02)
+        g.first_seen("vote", 5, 0, 1)  # re-delivery: no-op
+        assert g.snapshot()["first_seen"]["vote/5/0/1"] == t0
+
+    def test_record_is_thread_safe(self):
+        g = GossipRollup(enabled=True)
+
+        def pump(pid):
+            for _ in range(500):
+                g.record(pid, "recv", 0x30, b"\x01tx", 32)
+
+        threads = [
+            threading.Thread(target=pump, args=(f"p{i}",)) for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert g.snapshot()["channels"]["mempool"]["recv_msgs"] == 2000
+
+
+class TestDisabledRollup:
+    def test_disabled_is_a_noop(self):
+        g = GossipRollup(enabled=False)
+        g.record("p", "recv", 0x22, b"\x06v", 64)
+        g.redundant("vote", 64)
+        g.first_seen("vote", 1, 0, 0)
+        snap = g.snapshot()
+        assert snap["enabled"] is False
+        assert snap["peers"] == {}
+        assert snap["redundant"] == {}
+        assert snap["first_seen"] == {}
+        assert g.headline() == {"enabled": False}
+        assert g.redundancy_factors() == {}
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.delenv("TENDERMINT_TPU_GOSSIPLOG", raising=False)
+        assert enabled_from_env() is True
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "0")
+        assert enabled_from_env() is False
+        assert GossipRollup().enabled is False
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "1")
+        assert GossipRollup().enabled is True
+
+
+class TestRedundancyFactors:
+    def test_delivered_over_useful(self):
+        g = GossipRollup(enabled=True)
+        for _ in range(10):
+            g.record("p", "recv", 0x22, b"\x06v", 64)
+        for _ in range(4):
+            g.redundant("vote", 64)
+        # 10 delivered, 4 were dups -> 6 useful -> 10/6
+        assert g.redundancy_factors()["vote"] == round(10 / 6, 3)
+
+    def test_wire_kind_join_for_evidence(self):
+        """Redundancy is counted as "evidence" at the pool, but the wire
+        kind is "evidence_list" — the factor must join the two."""
+        g = GossipRollup(enabled=True)
+        for _ in range(4):
+            g.record("p", "recv", 0x38, b"\x01ev", 100)
+        g.redundant("evidence", 100)
+        assert g.redundancy_factors()["evidence"] == round(4 / 3, 3)
+
+    def test_fallback_without_wire_traffic(self):
+        """Dedup'd adds with no recv accounting (e.g. rollup attached
+        mid-run) still report: factor = dups on top of one useful."""
+        g = GossipRollup(enabled=True)
+        g.redundant("tx", 32)
+        g.redundant("tx", 32)
+        assert g.redundancy_factors()["tx"] == 3.0
+
+    def test_headline_names_top_waste(self):
+        g = GossipRollup(enabled=True)
+        g.record("p", "recv", 0x21, b"\x05part", 4096)
+        g.redundant("vote", 64)
+        g.redundant("block_part", 4096)
+        g.redundant("block_part", 4096)
+        h = g.headline()
+        assert h["top_redundant_kind"] == "block_part"
+        assert h["top_redundant_msgs"] == 2
+        assert h["hottest_channel"] == "cns_data"
+        assert h["hottest_channel_bytes"] == 4096
+
+
+class _Tap:
+    """Endpoint wrapper that records every raw wire write, unmodified."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.frames = []
+
+    def send(self, data):
+        self.frames.append(bytes(data))
+        self._inner.send(data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class TestGoldenBytes:
+    """Accounting observes frames; it must NEVER change them. The same
+    send schedule produces byte-identical wire traffic with the
+    on_traffic hook installed vs sampled out (None)."""
+
+    PAYLOADS = [
+        (0x22, b"\x06" + b"v" * 80),
+        (0x21, b"\x05" + b"p" * 200),
+        (0x30, b"\x01" + b"t" * 33),
+        (0x22, b"\x06" + b"w" * 80),
+    ]
+
+    def _pump(self, on_traffic):
+        ea, eb = pipe_pair()
+        tap = _Tap(ea)
+        got = queue.Queue()
+        chans = [
+            ChannelDescriptor(0x21),
+            ChannelDescriptor(0x22),
+            ChannelDescriptor(0x30),
+        ]
+        ca = MConnection(tap, chans, lambda c, p: None,
+                         ping_interval=0, on_traffic=on_traffic)
+        cb = MConnection(eb, chans, lambda c, p: got.put((c, p)),
+                         ping_interval=0)
+        ca.start()
+        cb.start()
+        try:
+            for chan, payload in self.PAYLOADS:
+                ca.send(chan, payload)
+                # serialize sends so the frame order is deterministic
+                assert got.get(timeout=2) == (chan, payload)
+        finally:
+            ca.stop()
+            cb.stop()
+        return tap.frames
+
+    def test_frames_byte_identical_with_accounting(self):
+        g = GossipRollup(enabled=True)
+        hook = lambda d, c, p, n: g.record("peer", d, c, p, n)  # noqa: E731
+        instrumented = self._pump(hook)
+        plain = self._pump(None)
+        assert instrumented == plain
+        # and the hook really saw every frame, sized as-on-the-wire
+        snap = g.snapshot()
+        assert snap["kinds"]["vote"]["send_msgs"] == 2
+        assert snap["kinds"]["block_part"]["send_msgs"] == 1
+        assert snap["kinds"]["tx"]["send_msgs"] == 1
+        wire_bytes = sum(len(f) for f in instrumented)
+        counted = sum(
+            st["send_bytes"] for st in snap["channels"].values()
+        )
+        assert counted == wire_bytes
+
+    def test_build_frame_ignores_gossip_env(self, monkeypatch):
+        from tendermint_tpu.p2p.connection import build_frame
+
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "1")
+        on = build_frame(0x22, b"\x06payload")
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "0")
+        off = build_frame(0x22, b"\x06payload")
+        assert on == off
+
+
+class _Echo(Reactor):
+    def __init__(self, chan_id):
+        super().__init__()
+        self.chan_id = chan_id
+        self.got = queue.Queue()
+
+    def get_channels(self):
+        return [ChannelDescriptor(self.chan_id)]
+
+    def receive(self, chan_id, peer, data):
+        self.got.put(bytes(data))
+
+
+class TestInterop:
+    def test_instrumented_and_sampled_out_switches_interop(self, monkeypatch):
+        """A TENDERMINT_TPU_GOSSIPLOG=0 node and an instrumented node
+        speak the same protocol: traffic flows both ways, the
+        instrumented side counts it, the sampled-out side counts
+        nothing (and pays nothing: its peers get no hook)."""
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "0")
+        plain = Switch(NodeInfo("p" * 40, "plain", "interop"))
+        monkeypatch.setenv("TENDERMINT_TPU_GOSSIPLOG", "1")
+        inst = Switch(NodeInfo("i" * 40, "inst", "interop"))
+        assert plain.gossip.enabled is False
+        assert inst.gossip.enabled is True
+        plain.ping_interval = inst.ping_interval = 0
+        er_p = plain.add_reactor("echo", _Echo(0x22))
+        er_i = inst.add_reactor("echo", _Echo(0x22))
+        plain.start()
+        inst.start()
+        try:
+            pp, pi = connect_switches(plain, inst)
+            assert pp.send(0x22, b"\x06from-plain")
+            assert pi.send(0x22, b"\x06from-inst")
+            assert er_i.got.get(timeout=2) == b"\x06from-plain"
+            assert er_p.got.get(timeout=2) == b"\x06from-inst"
+            wait_until(
+                lambda: inst.gossip.snapshot()["kinds"]
+                .get("vote", {})
+                .get("recv_msgs", 0)
+                >= 1,
+                msg="instrumented recv accounting",
+            )
+            snap = inst.gossip.snapshot()
+            assert snap["kinds"]["vote"]["send_msgs"] >= 1
+            assert "p" * 40 in snap["peers"]
+            assert plain.gossip.snapshot()["peers"] == {}
+        finally:
+            plain.stop()
+            inst.stop()

@@ -1378,6 +1378,146 @@ def drive_scenario_finality(names) -> dict:
     return out
 
 
+def drive_gossip_efficiency(n_msgs: int) -> dict:
+    """`gossip_efficiency` section (the gossip observatory, PR 17) —
+    two halves:
+
+    * **accounting overhead guard**: vote-tagged frames pumped through
+      a connected switch pair with `TENDERMINT_TPU_GOSSIPLOG=0` vs on;
+      classifying + rolling up every frame (channel name, kind tag,
+      per-peer table row, two counter incs) must stay within 3% of
+      off. Best-of-3 per half — pipe throughput is scheduler-noisy.
+    * **redundancy factor on the 4-node loadgen net**: a short live
+      Nemesis run on the flash-crowd WAN fabric under steady load; the
+      per-kind delivered/useful factors from the fleet rollup are the
+      measured over-gossip numbers (vote > 1.0 = the HasVote race is
+      real, the before-number for the ROADMAP item 3 aggregation lane).
+    """
+    import copy
+    import threading as _threading
+
+    from tendermint_tpu.p2p.connection import ChannelDescriptor
+    from tendermint_tpu.p2p.peer import NodeInfo
+    from tendermint_tpu.p2p.switch import Reactor, Switch, connect_switches
+    from tendermint_tpu.testing.scenario import ScenarioRunner
+
+    vote_chan = 0x22
+    payload = b"\x06" + b"v" * 160  # vote-tagged, vote-sized
+
+    class _Sink(Reactor):
+        def __init__(self) -> None:
+            super().__init__()
+            self.count = 0
+            self.target = 0
+            self.done = _threading.Event()
+
+        def get_channels(self):
+            return [
+                ChannelDescriptor(
+                    vote_chan, priority=5, send_queue_capacity=1024
+                )
+            ]
+
+        def receive(self, chan_id, peer, data) -> None:
+            self.count += 1
+            if self.count >= self.target:
+                self.done.set()
+
+    def run_half() -> tuple[float, int]:
+        a = Switch(NodeInfo("a" * 40, "bench-a", "bench-gossip"))
+        b = Switch(NodeInfo("b" * 40, "bench-b", "bench-gossip"))
+        a.ping_interval = b.ping_interval = 0
+        a.add_reactor("sink", _Sink())
+        sink = b.add_reactor("sink", _Sink())
+        sink.target = n_msgs
+        a.start()
+        b.start()
+        pa, _pb = connect_switches(a, b)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n_msgs):
+                assert pa.send(vote_chan, payload, ctx=None)
+            assert sink.done.wait(timeout=60)
+            mps = n_msgs / (time.perf_counter() - t0)
+            snap = b.gossip.snapshot()
+            counted = (
+                snap["kinds"].get("vote", {}).get("recv_msgs", 0)
+                if snap["enabled"]
+                else 0
+            )
+            return mps, counted
+        finally:
+            a.stop()
+            b.stop()
+
+    prev = os.environ.get("TENDERMINT_TPU_GOSSIPLOG")
+    try:
+        os.environ["TENDERMINT_TPU_GOSSIPLOG"] = "0"
+        run_half()  # warmup: thread spin-up excluded from both halves
+        off_mps = max(run_half()[0] for _ in range(3))
+        os.environ["TENDERMINT_TPU_GOSSIPLOG"] = "1"
+        on_runs = [run_half() for _ in range(3)]
+        on_mps = max(r[0] for r in on_runs)
+        msgs_counted = max(r[1] for r in on_runs)
+    finally:
+        if prev is None:
+            os.environ.pop("TENDERMINT_TPU_GOSSIPLOG", None)
+        else:
+            os.environ["TENDERMINT_TPU_GOSSIPLOG"] = prev
+    overhead_pct = 100.0 * (1.0 - on_mps / off_mps)
+
+    # redundancy half: 4 full nodes on the flash-crowd WAN fabric under
+    # steady load — a real consensus run, so vote/part/tx dedup sites
+    # see genuine gossip races
+    spec = {
+        "name": "gossip_probe",
+        "description": "bench probe: 4-node WAN loadgen redundancy",
+        "nodes": 4,
+        "kind": "full",
+        "topology": {
+            "placement": ["us-east", "us-west", "eu-west", "us-east"],
+            "scale": 0.1,
+        },
+        "config": {
+            "timeout_propose_ms": 1000,
+            "timeout_prevote_ms": 300,
+            "timeout_precommit_ms": 300,
+        },
+        "load": {"rate": 25.0, "payload": 64},
+        "run": {"target_height": 8, "timeout_s": 120.0},
+        "expect": {
+            "min_height": 8,
+            "gossip": {"require_counted": True},
+        },
+    }
+    sys.stderr.write("  gossip redundancy probe (4-node WAN loadgen)...\n")
+    report = ScenarioRunner(
+        home=tempfile.mkdtemp(prefix="hotpath-gossip-")
+    ).run(copy.deepcopy(spec))
+    g = report.get("gossip") or {}
+    factors = dict(g.get("redundancy_factor") or {})
+    # vote traffic with zero recorded duplicates is a 1.0x factor, not
+    # a missing measurement (the floor guards presence + sanity)
+    if "vote" not in factors and (g.get("channel_bytes") or {}).get("cns_vote"):
+        factors["vote"] = 1.0
+    return {
+        "messages": n_msgs,
+        "accounting_off_msgs_per_s": round(off_mps, 1),
+        "accounting_on_msgs_per_s": round(on_mps, 1),
+        "overhead_pct": round(overhead_pct, 2),
+        "within_3pct": overhead_pct <= 3.0,
+        # proof the on half classified + rolled up real frames, not a
+        # silently-disabled no-op
+        "msgs_counted": msgs_counted,
+        "probe_ok": report["ok"],
+        "probe_total_bytes": g.get("total_bytes"),
+        "probe_channel_bytes": g.get("channel_bytes"),
+        "redundancy_factor": factors,
+        "redundancy_factor_vote": factors.get("vote"),
+        "top_redundant_kind": g.get("top_redundant_kind"),
+    }
+
+
 def drive_wal(n_records: int) -> None:
     from tendermint_tpu.consensus.wal import WAL, EndHeightMessage
 
@@ -1704,6 +1844,13 @@ def main(argv=None) -> int:
         "adaptive-timeout A/B on the slow-WAN topology always rides "
         "with it)",
     )
+    ap.add_argument(
+        "--gossip-msgs",
+        type=int,
+        default=4000,
+        help="frames for the gossip-accounting overhead guard "
+        "(0 skips the gossip_efficiency section)",
+    )
     args = ap.parse_args(argv)
     sizes = [int(s) for s in args.sizes.split(",") if s]
 
@@ -1854,6 +2001,13 @@ def main(argv=None) -> int:
             "+ adaptive-timeout A/B...\n"
         )
         scenario_finality = drive_scenario_finality(scenario_names)
+    gossip_efficiency = None
+    if args.gossip_msgs > 0:
+        sys.stderr.write(
+            f"driving gossip-accounting guard: {args.gossip_msgs} frames "
+            "(off vs on) + 4-node WAN redundancy probe...\n"
+        )
+        gossip_efficiency = drive_gossip_efficiency(args.gossip_msgs)
     detail = {
         "wall_s": round(time.time() - t0, 2),
         "backend": jax.default_backend(),
@@ -1871,6 +2025,7 @@ def main(argv=None) -> int:
         "sharded_verify": sharded_verify,
         "finality": finality,
         "scenario_finality": scenario_finality,
+        "gossip_efficiency": gossip_efficiency,
         "wal_fsync": {
             "count": wal_count,
             "fsyncs_per_s": round(wal_count / wal_sum, 1) if wal_sum else None,

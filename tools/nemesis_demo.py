@@ -507,6 +507,16 @@ def run_scenarios_scenario(args) -> int:
             )
         if "bisection" in report:
             detail += f", bisected to h{report['bisection']['verified_to']}"
+        g = report.get("gossip")
+        if g:
+            detail += f", gossip {g['total_bytes'] / 1e6:.1f}MB"
+            if g["redundancy_factor"]:
+                detail += " (" + ", ".join(
+                    f"{k} {f:.1f}x dup"
+                    for k, f in sorted(
+                        g["redundancy_factor"].items(), key=lambda kv: -kv[1]
+                    )
+                ) + ")"
         if report["failures"]:
             detail += f" — {'; '.join(report['failures'])}"
         verdicts.append(
@@ -519,6 +529,30 @@ def run_scenarios_scenario(args) -> int:
     for scenario, verdict, detail in verdicts:
         print(f"  {scenario:<{width}}  {verdict}  {detail}")
         failed += verdict != "PASS"
+    # the gossip verdict table: per-channel bandwidth + per-kind
+    # redundancy, fleet-summed across the book's scenarios (the same
+    # rollup tools/gossip_report.py renders per node)
+    chan_totals: dict[str, int] = {}
+    red_totals: dict[str, dict] = {}
+    for report in reports:
+        g = report.get("gossip")
+        if not g:
+            continue
+        for c, b in g["channel_bytes"].items():
+            chan_totals[c] = chan_totals.get(c, 0) + b
+        for k, st in g["redundant"].items():
+            r = red_totals.setdefault(k, {"msgs": 0, "bytes": 0})
+            r["msgs"] += st["msgs"]
+            r["bytes"] += st["bytes"]
+    if chan_totals:
+        print("\ngossip verdict (book total):")
+        for c, b in sorted(chan_totals.items(), key=lambda kv: -kv[1]):
+            print(f"  {c:<14} {b / 1e6:>8.2f}MB")
+        for k, r in sorted(red_totals.items(), key=lambda kv: -kv[1]["bytes"]):
+            print(
+                f"  redundant {k:<11} {r['msgs']:>6} msgs "
+                f"{r['bytes'] / 1e3:>8.1f}kB"
+            )
     return 1 if failed else 0
 
 

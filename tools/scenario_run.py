@@ -56,6 +56,39 @@ def _detail(report: dict) -> str:
     return ", ".join(bits)
 
 
+def _fmt_mb(n: float) -> str:
+    return f"{n / 1e6:.2f}MB" if n >= 1e5 else f"{n / 1e3:.1f}kB"
+
+
+def _print_gossip_table(report: dict) -> None:
+    """The gossip verdict table (bandwidth per channel, redundancy
+    factor per kind) from the scenario's fleet-wide rollup — printed
+    alongside the finality report so over-gossip is visible in the same
+    place as slow finality."""
+    g = report.get("gossip")
+    if not g:
+        return
+    chans = ", ".join(
+        f"{c} {_fmt_mb(b)}"
+        for c, b in sorted(
+            g["channel_bytes"].items(), key=lambda kv: -kv[1]
+        )[:5]
+    )
+    print(f"    gossip: {_fmt_mb(g['total_bytes'])} on the wire — {chans}")
+    if g["redundancy_factor"]:
+        factors = ", ".join(
+            f"{k} {f:.2f}x ({_fmt_mb(g['redundant'][k]['bytes'])} dup)"
+            for k, f in sorted(
+                g["redundancy_factor"].items(), key=lambda kv: -kv[1]
+            )
+        )
+        top = g.get("top_redundant_kind")
+        print(
+            f"    redundancy: {factors}"
+            + (f" — top waste: {top}" if top else "")
+        )
+
+
 def main() -> int:
     from tendermint_tpu.testing.scenario import (
         SCENARIO_LIBRARY,
@@ -127,6 +160,7 @@ def main() -> int:
         reports.append(report)
         verdict = "PASS" if report["ok"] else "FAIL"
         print(f"    {verdict} in {report['elapsed_s']}s — {_detail(report)}")
+        _print_gossip_table(report)
         for failure in report["failures"]:
             print(f"    failure: {failure}")
 
